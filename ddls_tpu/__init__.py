@@ -1,0 +1,21 @@
+"""ddls_tpu: a TPU-native framework with the capabilities of cwfparsonson/ddls.
+
+Two halves, mirroring the reference (see SURVEY.md):
+
+1. A discrete-event simulator of a distributed deep-learning cluster (the RAMP
+   all-optical architecture): jobs are DNN computation graphs, actions are
+   resource-management decisions (op partitioning / placement, flow routing and
+   scheduling), and the simulator computes job completion times, blocking rates
+   and throughputs.
+
+2. A reinforcement-learning stack (PAC-ML) that learns how many times to
+   partition each job's ops: an environment wrapping the simulator, a
+   message-passing GNN policy written in flax with XLA-native segment ops, and a
+   pure-JAX PPO learner that shards its update over a ``jax.sharding.Mesh``
+   (gradient all-reduce = ``psum`` over the ICI mesh) with vectorised rollouts.
+
+Where the reference (PyTorch/DGL/RLlib/Ray) delegates compute to CUDA, this
+package is JAX/XLA-first and designed for TPU pod slices.
+"""
+
+__version__ = "0.1.0"

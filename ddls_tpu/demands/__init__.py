@@ -1,0 +1,5 @@
+from ddls_tpu.demands.job import Job
+from ddls_tpu.demands.jobs_generator import JobsGenerator
+from ddls_tpu.demands.job_queue import JobQueue
+
+__all__ = ["Job", "JobsGenerator", "JobQueue"]

@@ -1,0 +1,13 @@
+from ddls_tpu.graphs.op_graph import OpGraph
+from ddls_tpu.graphs.readers import (
+    graph_from_pipedream_txt,
+    graph_from_pbtxt,
+)
+from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+
+__all__ = [
+    "OpGraph",
+    "graph_from_pipedream_txt",
+    "graph_from_pbtxt",
+    "generate_pipedream_txt_files",
+]

@@ -1,0 +1,17 @@
+from ddls_tpu.utils.common import (
+    Stopwatch,
+    flatten_lists,
+    get_class_from_path,
+    seed_everything,
+    unique_experiment_dir,
+    recursive_update,
+)
+
+__all__ = [
+    "Stopwatch",
+    "flatten_lists",
+    "get_class_from_path",
+    "seed_everything",
+    "unique_experiment_dir",
+    "recursive_update",
+]

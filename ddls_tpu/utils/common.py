@@ -1,0 +1,122 @@
+"""Small shared utilities.
+
+TPU-native counterpart of the reference's grab-bag ``ddls/utils.py``
+(reference: ddls/utils.py:20-104,485-558). Seeding covers numpy/random and
+returns a JAX PRNG key instead of touching torch/CUDA state.
+"""
+from __future__ import annotations
+
+import glob
+import importlib
+import pathlib
+import random
+from typing import Any, Mapping
+
+import numpy as np
+
+
+class Stopwatch:
+    """Simulated wall clock (reference: ddls/utils.py:485)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._time = 0.0
+
+    def tick(self, amount: float = 1.0) -> None:
+        self._time += amount
+
+    def time(self) -> float:
+        return self._time
+
+
+def seed_everything(seed: int):
+    """Seed numpy + stdlib random; return a jax PRNG key for functional use.
+
+    The reference seeds numpy/random/torch-CUDA globally
+    (ddls/utils.py:20-47); in JAX randomness is functional, so we hand back a
+    key to thread through the program instead of mutating backend state.
+    """
+    np.random.seed(seed)
+    random.seed(seed)
+    try:
+        import jax
+
+        return jax.random.PRNGKey(seed)
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        return None
+
+
+def flatten_lists(nested) -> list:
+    return [item for sub in nested for item in sub]
+
+
+def get_class_from_path(path: str):
+    """Import ``pkg.module.ClassName`` from its dotted path.
+
+    Also accepts reference-repo class paths (``ddls.devices...``) and maps them
+    onto their ddls_tpu equivalents so the reference Hydra config trees run
+    unchanged (reference: ddls/utils.py:513).
+    """
+    path = _REFERENCE_CLASS_ALIASES.get(path, path)
+    module_path, _, name = path.rpartition(".")
+    module = importlib.import_module(module_path)
+    return getattr(module, name)
+
+
+# Class paths appearing in the reference's config trees, mapped to ours.
+_REFERENCE_CLASS_ALIASES = {
+    "ddls.devices.processors.gpus.A100.A100": "ddls_tpu.hardware.devices.A100",
+    "ddls.distributions.fixed.Fixed": "ddls_tpu.demands.distributions.Fixed",
+    "ddls.distributions.uniform.Uniform": "ddls_tpu.demands.distributions.Uniform",
+    "ddls.distributions.probability_mass_function.ProbabilityMassFunction":
+        "ddls_tpu.demands.distributions.ProbabilityMassFunction",
+    "ddls.distributions.custom_skew_norm.CustomSkewNorm":
+        "ddls_tpu.demands.distributions.CustomSkewNorm",
+    "ddls.distributions.list_of_distributions.ListOfDistributions":
+        "ddls_tpu.demands.distributions.ListOfDistributions",
+    "ddls.environments.ramp_job_partitioning.ramp_job_partitioning_environment.RampJobPartitioningEnvironment":
+        "ddls_tpu.envs.partitioning_env.RampJobPartitioningEnvironment",
+    "ddls.environments.ramp_job_placement_shaping.ramp_job_placement_shaping_environment.RampJobPlacementShapingEnvironment":
+        "ddls_tpu.envs.placement_shaping_env.RampJobPlacementShapingEnvironment",
+    "ddls.loops.eval_loop.EvalLoop": "ddls_tpu.train.loops.EvalLoop",
+    "ddls.environments.ramp_job_partitioning.agents.random.Random":
+        "ddls_tpu.envs.baselines.RandomActor",
+    "ddls.environments.ramp_job_partitioning.agents.no_parallelism.NoParallelism":
+        "ddls_tpu.envs.baselines.NoParallelism",
+    "ddls.environments.ramp_job_partitioning.agents.min_parallelism.MinParallelism":
+        "ddls_tpu.envs.baselines.MinParallelism",
+    "ddls.environments.ramp_job_partitioning.agents.max_parallelism.MaxParallelism":
+        "ddls_tpu.envs.baselines.MaxParallelism",
+    "ddls.environments.ramp_job_partitioning.agents.sip_ml.SiPML":
+        "ddls_tpu.envs.baselines.SiPML",
+    "ddls.environments.ramp_job_partitioning.agents.acceptable_jct.AcceptableJCT":
+        "ddls_tpu.envs.baselines.AcceptableJCT",
+}
+
+
+def unique_experiment_dir(base: str, name: str) -> str:
+    """Create ``base/name/name_<i>/`` with the next free integer suffix
+    (reference: ddls/utils.py:530)."""
+    root = pathlib.Path(base) / name
+    root.mkdir(parents=True, exist_ok=True)
+    taken = []
+    for item in glob.glob(str(root / f"{name}_*")):
+        tail = item.rsplit("_", 1)[-1]
+        if tail.isdigit():
+            taken.append(int(tail))
+    idx = max(taken) + 1 if taken else 0
+    out = root / f"{name}_{idx}"
+    out.mkdir(parents=True, exist_ok=False)
+    return str(out)
+
+
+def recursive_update(base: dict, overrides: Mapping[str, Any]) -> dict:
+    """Deep-merge ``overrides`` into ``base`` (reference: ddls/utils.py:577)."""
+    for key, val in overrides.items():
+        if key in base and isinstance(base[key], dict) and isinstance(val, Mapping):
+            recursive_update(base[key], val)
+        else:
+            base[key] = val
+    return base

@@ -1,0 +1,34 @@
+"""Test harness config.
+
+Force JAX onto a virtual 8-device CPU mesh before jax initialises, so all
+sharding/pjit/psum code paths are exercised without TPU hardware (the standard
+JAX substitute for a fake multi-chip backend; see SURVEY.md §4).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import random
+
+    np.random.seed(0)
+    random.seed(0)
+
+
+@pytest.fixture(scope="session")
+def dataset_dir(tmp_path_factory):
+    from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+
+    out = tmp_path_factory.mktemp("small_graphs")
+    generate_pipedream_txt_files(str(out), n_cnn=2, n_translation=1, seed=0,
+                                 min_ops=4, max_ops=6)
+    return str(out)
