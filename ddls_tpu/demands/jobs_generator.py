@@ -100,15 +100,18 @@ class JobsGenerator:
             raise ValueError(
                 "job_interarrival_time_dist is required (pass a Distribution "
                 "or a {'_target_': ..., **kwargs} dict)")
+        generated_paths = None
         if synthetic is not None:
             out_dir = synthetic.get("out_dir") or tempfile.mkdtemp(
                 prefix="ddls_tpu_jobs_")
             kw = {k: v for k, v in synthetic.items() if k != "out_dir"}
-            generate_pipedream_txt_files(out_dir, **kw)
+            # use exactly the files generated this run (a reused out_dir may
+            # hold stale profiles from a previous, differently-sized config)
+            generated_paths = generate_pipedream_txt_files(out_dir, **kw)
             path_to_files = out_dir
         self.path_to_files = path_to_files
 
-        file_paths = sorted(
+        file_paths = sorted(generated_paths) if generated_paths is not None else sorted(
             p for p in glob.glob(path_to_files.rstrip("/") + "/*")
             if p.endswith(".txt") or p.endswith(".pbtxt"))
         if not file_paths:

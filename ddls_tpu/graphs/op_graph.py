@@ -50,6 +50,8 @@ class OpGraph:
                is_forward: bool = True,
                counterpart: Optional[str] = None) -> None:
         op_id = str(op_id)
+        if op_id in self._compute:
+            raise ValueError(f"op {op_id!r} already exists in graph")
         self._compute[op_id] = float(compute)
         self._memory[op_id] = float(memory)
         self._is_forward[op_id] = bool(is_forward)

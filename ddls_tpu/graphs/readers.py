@@ -185,19 +185,25 @@ def graph_from_pbtxt(path: str,
     for the partitioning MDP).
     """
     blocks = _parse_pbtxt_nodes(path)
+    blocks = [b for b in blocks if isinstance(b.get("id"), int)]
+    # remap ids to contiguous 1..n (the backward-mirroring arithmetic needs
+    # 1-based contiguous ids; released pbtxt files may have sparse ids)
+    remap = {b["id"]: str(i + 1) for i, b in enumerate(
+        sorted(blocks, key=lambda b: b["id"]))}
     compute = {}
     out_sizes = {}
     data_edges: List[Tuple[str, str]] = []
     ctrl_edges: List[Tuple[str, str]] = []
     for block in blocks:
-        # shift ids by +1 so backward mirroring arithmetic (1-based) holds
-        node_id = str(int(block["id"]) + 1)
+        node_id = remap[block["id"]]
         compute[node_id] = float(block.get("compute_cost", 0))
         out_sizes[node_id] = list(block.get("output_info", [])) or [0]
         for parent in block.get("input_info", []):
-            data_edges.append((str(int(parent) + 1), node_id))
+            if parent in remap:
+                data_edges.append((remap[parent], node_id))
         for parent in block.get("control_input", []):
-            ctrl_edges.append((str(int(parent) + 1), node_id))
+            if parent in remap:
+                ctrl_edges.append((remap[parent], node_id))
 
     n = len(compute)
     g = OpGraph(device_type)
@@ -229,7 +235,7 @@ def graph_from_pbtxt(path: str,
         join_src = str(max(int(i) for i in compute))
         join_dst = str(min(int(backward_op_id(i, n)) for i in compute))
         if not g.has_edge(join_src, join_dst):
-            g.add_edge(join_src, join_dst, size=float(out_sizes[join_src][0]))
+            g.add_edge(join_src, join_dst, size=_size_of(join_src, True))
 
     g.meta["file_path"] = path
     g.meta["model"] = _model_name_from_path(path)

@@ -1,0 +1,7 @@
+from ddls_tpu.hardware.devices import A100, TPUv4, TPUv5e, Channel, Processor
+from ddls_tpu.hardware.topologies import RampTopology, TorusTopology, build_topology
+
+__all__ = [
+    "Processor", "A100", "TPUv4", "TPUv5e", "Channel",
+    "RampTopology", "TorusTopology", "build_topology",
+]

@@ -1,0 +1,128 @@
+"""Simulated cluster devices: worker processors and link channels.
+
+Counterpart of the reference's ``ddls/devices/`` (A100.py:7, channel.py:7).
+Workers track which job's ops are mounted (RAMP rule: at most one job per
+worker) plus occupied memory; channels track mounted flow deps per job. Both
+also carry the scheduling-priority maps written by the op/dep schedulers.
+
+The device catalogue includes the reference's profiled A100 plus TPU worker
+types so topologies can model pod slices; ``device_type`` keys the profiled
+compute costs in job graphs.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Set, Tuple
+
+
+class Processor:
+    """A worker device mounted in a server node."""
+
+    device_type = "generic"
+    memory_capacity = 0
+
+    def __init__(self, processor_id: Optional[str] = None):
+        self.processor_id = processor_id if processor_id is not None else str(id(self))
+        self.reset()
+
+    def reset(self) -> None:
+        self.memory_occupied = 0.0
+        self.mounted_job_idx_to_ops: Dict[int, Set[str]] = {}
+        self.mounted_job_id: Dict[int, int] = {}
+        self.op_priority: Dict[Tuple[int, str], int] = {}  # (job_idx, op_id) -> priority
+
+    def mount(self, job, op_id: str) -> None:
+        mem = job.graph.memory_cost(op_id)
+        job_idx = job.details["job_idx"]
+        if op_id in self.mounted_job_idx_to_ops.get(job_idx, ()):
+            raise RuntimeError(
+                f"worker {self.processor_id}: op {op_id} of job "
+                f"{job.job_id} is already mounted")
+        if self.memory_occupied + mem > self.memory_capacity:
+            raise MemoryError(
+                f"worker {self.processor_id}: op {op_id} of job "
+                f"{job.job_id} needs {mem} B but only "
+                f"{self.memory_capacity - self.memory_occupied} B free")
+        self.mounted_job_idx_to_ops.setdefault(job_idx, set()).add(op_id)
+        self.mounted_job_id[job_idx] = job.job_id
+        self.memory_occupied += mem
+
+    def unmount(self, job, op_id: str) -> None:
+        job_idx = job.details["job_idx"]
+        if op_id not in self.mounted_job_idx_to_ops.get(job_idx, ()):
+            raise RuntimeError(
+                f"worker {self.processor_id}: op {op_id} of job "
+                f"{job.job_id} is not mounted")
+        self.memory_occupied -= job.graph.memory_cost(op_id)
+        self.mounted_job_idx_to_ops[job_idx].discard(op_id)
+        self.op_priority.pop((job_idx, op_id), None)
+        if not self.mounted_job_idx_to_ops[job_idx]:
+            del self.mounted_job_idx_to_ops[job_idx]
+            del self.mounted_job_id[job_idx]
+
+    @property
+    def memory_free(self) -> float:
+        return self.memory_capacity - self.memory_occupied
+
+    def __repr__(self) -> str:
+        return f"{self.device_type}({self.processor_id})"
+
+
+class A100(Processor):
+    """80 GB HBM GPU worker (reference: ddls/devices/processors/gpus/A100.py)."""
+
+    device_type = "A100"
+    memory_capacity = int(80e9)
+
+
+class TPUv4(Processor):
+    """TPU v4 chip: 32 GB HBM."""
+
+    device_type = "TPUv4"
+    memory_capacity = int(32e9)
+
+
+class TPUv5e(Processor):
+    """TPU v5e chip: 16 GB HBM."""
+
+    device_type = "TPUv5e"
+    memory_capacity = int(16e9)
+
+
+DEVICE_TYPES = {cls.device_type: cls for cls in (A100, TPUv4, TPUv5e)}
+
+
+def channel_id(src: str, dst: str, channel_number: int) -> str:
+    """(reference: ddls/utils.py:550 gen_channel_id)"""
+    return f"src_{src}_dst_{dst}_channel_{channel_number}"
+
+
+class Channel:
+    """One directed wavelength channel on a link
+    (reference: ddls/devices/channels/channel.py:7)."""
+
+    def __init__(self, src: str, dst: str, channel_number: int,
+                 channel_bandwidth: float):
+        self.src = src
+        self.dst = dst
+        self.channel_number = channel_number
+        self.channel_id = channel_id(src, dst, channel_number)
+        self.channel_bandwidth = channel_bandwidth
+        self.reset()
+
+    def reset(self) -> None:
+        self.mounted_job_idx_to_deps: Dict[int, Set[tuple]] = {}
+        self.dep_priority: Dict[Tuple[int, tuple], int] = {}
+
+    def mount(self, job, dep_id: tuple) -> None:
+        job_idx = job.details["job_idx"]
+        self.mounted_job_idx_to_deps.setdefault(job_idx, set()).add(dep_id)
+
+    def unmount(self, job, dep_id: tuple) -> None:
+        job_idx = job.details["job_idx"]
+        self.mounted_job_idx_to_deps[job_idx].discard(dep_id)
+        self.dep_priority.pop((job_idx, dep_id), None)
+        if not self.mounted_job_idx_to_deps[job_idx]:
+            del self.mounted_job_idx_to_deps[job_idx]
+
+    def __repr__(self) -> str:
+        return f"Channel({self.channel_id})"

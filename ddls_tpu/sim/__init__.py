@@ -1,0 +1,9 @@
+from ddls_tpu.sim.comm_model import (
+    one_to_one_time,
+    ramp_all_reduce_time,
+)
+
+__all__ = [
+    "one_to_one_time",
+    "ramp_all_reduce_time",
+]

@@ -1,0 +1,145 @@
+"""L2 tests: topologies, devices, RAMP analytical communication model."""
+import math
+
+import numpy as np
+import pytest
+
+from ddls_tpu.hardware import A100, RampTopology, TorusTopology, build_topology
+from ddls_tpu.sim.comm_model import (collective_span, effective_transceivers,
+                                     one_to_one_time, parallel_add_time,
+                                     ramp_all_reduce_time)
+
+
+def _node_config(n, worker="A100"):
+    return {"type_1": {"num_nodes": n,
+                       "workers_config": [{"num_workers": 1, "worker": worker}]}}
+
+
+def test_ramp_topology_structure():
+    topo = RampTopology(num_communication_groups=2,
+                        num_racks_per_communication_group=2,
+                        num_servers_per_rack=2,
+                        num_channels=1,
+                        total_node_bandwidth=1.6e12)
+    assert topo.num_servers == 8
+    assert topo.channel_bandwidth == pytest.approx(0.8e12)
+    # full mesh: C(8,2)=28 links, one channel per direction
+    assert len(topo.links) == 28
+    assert len(topo.channel_id_to_channel) == 56
+    # one-hop shortest paths
+    assert topo.shortest_paths["0-0-0"]["1-1-1"] == [["0-0-0", "1-1-1"]]
+
+    topo.populate_workers(_node_config(8))
+    assert topo.num_workers == 8
+    assert topo.worker_types == {"A100"}
+    assert topo.worker_to_server["node_0-1-0_worker_0"] == "0-1-0"
+
+
+def test_ramp_rejects_invalid_shape_and_node_count():
+    with pytest.raises(ValueError):
+        RampTopology(num_communication_groups=2,
+                     num_racks_per_communication_group=4,
+                     num_servers_per_rack=1)
+    topo = RampTopology(2, 2, 2)
+    with pytest.raises(ValueError):
+        topo.populate_workers(_node_config(5))
+
+
+def test_build_topology_from_config():
+    topo = build_topology({"type": "ramp", "kwargs": {
+        "num_communication_groups": 4,
+        "num_racks_per_communication_group": 4,
+        "num_servers_per_rack": 2,
+        "num_channels": 1,
+        "total_node_bandwidth": 1.6e12,
+        "intra_gpu_propagation_latency": 50e-9,
+        "worker_io_latency": 100e-9}})
+    assert topo.num_servers == 32
+    assert topo.channel_bandwidth == pytest.approx(0.4e12)
+
+
+def test_torus_topology():
+    topo = TorusTopology(x_dims=3, y_dims=3)
+    assert topo.num_servers == 9
+    # 2D torus: 2 links per node, each counted once -> 18 links
+    assert len(topo.links) == 18
+    path = topo.shortest_paths["0-0"]["2-0"][0]
+    assert len(path) == 2  # wrap-around neighbour
+
+
+def test_worker_mount_memory_accounting(dataset_dir):
+    import glob
+
+    from ddls_tpu.demands.job import Job
+    from ddls_tpu.graphs.readers import graph_from_pipedream_txt
+
+    g = graph_from_pipedream_txt(sorted(glob.glob(dataset_dir + "/*.txt"))[0])
+    job = Job(g, 1, 1.0, job_id=1, details={"job_idx": 0})
+    w = A100(processor_id="w0")
+    op = g.op_ids[0]
+    w.mount(job, op)
+    assert w.memory_occupied == pytest.approx(g.memory_cost(op))
+    assert w.mounted_job_idx_to_ops[0] == {op}
+    w.unmount(job, op)
+    assert w.memory_occupied == pytest.approx(0.0)
+    assert 0 not in w.mounted_job_idx_to_ops
+
+
+def test_one_to_one_closed_form():
+    t = one_to_one_time(1e9, data_rate=4e11, propagation_latency=50e-9,
+                        io_latency=100e-9)
+    assert t == pytest.approx(50e-9 + 200e-9 + 1e9 / 4e11)
+
+
+def test_effective_transceivers():
+    assert effective_transceivers(4, 1) == 0.0
+    # d=2, J=1: 1 + min(4, 4) - 1 = 4
+    assert effective_transceivers(4, 2, 1) == 4.0
+    # d=5, J=1: 1 + min(4, 1) - 1 = 1
+    assert effective_transceivers(4, 5, 1) == 1.0
+
+
+def test_parallel_add_roofline():
+    # devices=2: n_op=1, n_bytes=6, AI=1/6, ops=data/4
+    t = parallel_add_time(1000.0, 2, mem_frequency=2e12, peak_flops=130e12)
+    expected = (1 * (1000.0 / 2) / 2) / min(2e12 / 6, 130e12)
+    assert t == pytest.approx(expected)
+
+
+def test_ramp_all_reduce_against_manual_expansion():
+    """Independently expand the documented reduce-scatter+all-gather formula
+    and check the implementation reproduces it step by step."""
+    kwargs = dict(message_size=1e9, num_servers=2, num_racks=2,
+                  num_comm_groups=2, network_comm_groups=4,
+                  data_rate=4e11, propagation_latency=50e-9,
+                  io_latency=100e-9)
+    got = ramp_all_reduce_time(**kwargs)
+
+    x, rate = 4, 4e11
+    data_per_tx = rate / x
+    subs = [2, 2, 2, math.ceil(2 / 4)]
+    msgs = [math.ceil(1e9 / 2)]
+    for s in subs[1:]:
+        msgs.append(math.ceil(msgs[-1] / s))
+    comm = comp = 0.0
+    for step, s in enumerate(subs):
+        if s > 1:
+            comp += parallel_add_time(msgs[step] * s, s)
+            bw = effective_transceivers(x, s, 1) * data_per_tx
+            comm += 50e-9 + 2 * 100e-9 + msgs[step] / bw
+    assert got == pytest.approx(2 * comm + comp)
+    assert got > 0
+
+
+def test_all_reduce_monotonic_in_message_size():
+    base = dict(num_servers=4, num_racks=2, num_comm_groups=2,
+                network_comm_groups=4, data_rate=4e11)
+    t1 = ramp_all_reduce_time(message_size=1e8, **base)
+    t2 = ramp_all_reduce_time(message_size=1e9, **base)
+    assert t2 > t1
+
+
+def test_collective_span():
+    cgs, racks, servers, full = collective_span(
+        ["0-0-0", "0-1-0", "1-0-1", "1-0-0"])
+    assert (cgs, racks, servers, full) == (2, 2, 2, 4)
