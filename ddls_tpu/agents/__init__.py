@@ -1,0 +1,12 @@
+from ddls_tpu.agents.partitioners import (RandomOpPartitioner,
+                                          SipMlOpPartitioner,
+                                          sip_ml_num_partitions)
+from ddls_tpu.agents.placers import (FirstFitDepPlacer, RampFirstFitOpPlacer,
+                                     RandomOpPlacer)
+from ddls_tpu.agents.schedulers import SRPTDepScheduler, SRPTOpScheduler
+
+__all__ = [
+    "SipMlOpPartitioner", "RandomOpPartitioner", "sip_ml_num_partitions",
+    "RampFirstFitOpPlacer", "RandomOpPlacer", "FirstFitDepPlacer",
+    "SRPTOpScheduler", "SRPTDepScheduler",
+]

@@ -1,0 +1,177 @@
+"""Symmetric server-block search for RAMP collective placement.
+
+RAMP collectives require symmetric server blocks: a split op's sub-ops must
+land on a block of servers whose (c, r, s) shape satisfies the RAMP symmetry
+rules. This module provides the first-fit search over candidate block shapes
+used by the placer and by action-mask computation
+(reference: ddls/environments/ramp_cluster/agents/placers/utils.py:13-530).
+
+Search order is preserved exactly (factor pairs ascending, square shapes
+before row/column shapes, diagonal fallback last; origins scanned
+c-major/r/s) because "first fit" makes the order part of the semantics.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Coord = Tuple[int, int, int]
+
+
+def snapshot_free_servers(cluster) -> Dict[Coord, dict]:
+    """Dict snapshot of per-server free memory and occupying jobs
+    (reference: placers/utils.py:235 dummy_ramp)."""
+    snap: Dict[Coord, dict] = {}
+    for server_id in cluster.topology.server_ids:
+        coord = cluster.topology.parse_server_id(server_id)
+        mem = 0.0
+        job_idxs: set = set()
+        for worker_id in cluster.topology.server_to_workers.get(server_id, []):
+            worker = cluster.topology.workers[worker_id]
+            mem += worker.memory_free
+            if worker.mounted_job_idx_to_ops:
+                job_idxs.update(worker.mounted_job_idx_to_ops.keys())
+        snap[coord] = {"mem": mem, "job_idxs": job_idxs}
+    return snap
+
+
+def factor_pairs(n: int) -> List[Tuple[int, int]]:
+    """All (n/i, i) integer factor pairs, i ascending
+    (reference: placers/utils.py:445)."""
+    return [(n // i, i) for i in range(1, n + 1) if n % i == 0]
+
+
+def block_shapes_for(pairs: Sequence[Tuple[int, int]],
+                     meta_shape: Coord) -> List[Coord]:
+    """Candidate (C, R, S) block shapes fitting inside ``meta_shape``
+    (reference: placers/utils.py:491-530)."""
+    shapes: List[Coord] = []
+    for a, b in pairs:
+        root = math.sqrt(a)
+        if (root % 1 == 0 and root <= meta_shape[0]
+                and root <= meta_shape[1] and b <= meta_shape[2]):
+            shapes.append((int(root), int(root), b))
+        if a > meta_shape[0] or a > meta_shape[1] or b > meta_shape[2]:
+            continue
+        shapes.append((a, 1, b))
+        shapes.append((a, b, 1))
+    return shapes
+
+
+def enumerate_block(shape: Coord, ramp_shape: Coord,
+                    origin: Coord) -> List[Coord]:
+    """Servers covered by a block of ``shape`` at ``origin``. ``shape[2] ==
+    -1`` selects the diagonal layout across comm-groups and racks
+    (reference: placers/utils.py:464-489)."""
+    C, R, S = shape
+    i, j, k = origin
+    block: List[Coord] = []
+    if S == -1:
+        for n in range(C):
+            block.append(((i + n) % (ramp_shape[0] + 1),
+                          (j + n) % (ramp_shape[1] + 1),
+                          k % ramp_shape[2]))
+    else:
+        for c in range(C):
+            for r in range(R):
+                for s in range(S):
+                    block.append(((i + c) % ramp_shape[0],
+                                  (j + r) % ramp_shape[1],
+                                  (k + s) % ramp_shape[2]))
+    return block
+
+
+def block_ok(ramp: Dict[Coord, dict], block: Sequence[Coord],
+             op_size: Optional[float], job_idx) -> bool:
+    """Every server in the block must be free of other jobs and have
+    ``op_size`` memory available (reference: placers/utils.py:215-233;
+    ``op_size=None`` skips the memory check -- the reference's meta-mode call
+    passes None, which would TypeError under py3, see SURVEY.md §7.5
+    territory)."""
+    if not block:
+        return False
+    for server in block:
+        if server not in ramp:
+            return False
+        occupants = ramp[server]["job_idxs"]
+        if occupants and job_idx not in occupants:
+            return False
+        if op_size is not None and ramp[server]["mem"] < op_size:
+            return False
+    return True
+
+
+def first_fit_block(shapes: Sequence[Coord],
+                    meta_shape: Coord,
+                    ramp_shape: Coord,
+                    ramp: Dict[Coord, dict],
+                    job_idx,
+                    op_size: Optional[float] = None,
+                    origin: Coord = (0, 0, 0)) -> Optional[List[Coord]]:
+    """First valid block over shapes x origins
+    (reference: placers/utils.py:394-443 ff_block)."""
+    oc, orr, os_ = origin
+    for shape in shapes:
+        span = (meta_shape[0] - shape[0] + 1,
+                meta_shape[1] - shape[1] + 1,
+                meta_shape[2] - shape[2] + 1)
+        if span[0] <= 0 or span[1] <= 0 or span[2] <= 0:
+            continue
+        for i in range(span[0]):
+            for j in range(span[1]):
+                for k in range(span[2]):
+                    block = enumerate_block(
+                        shape, ramp_shape, (oc + i, orr + j, os_ + k))
+                    if block_ok(ramp, block, op_size, job_idx):
+                        return block
+    return None
+
+
+def find_sub_block(ramp: Dict[Coord, dict],
+                   ramp_shape: Coord,
+                   meta_shape: Coord,
+                   num_servers: int,
+                   op_size: float,
+                   job_idx) -> Optional[List[Coord]]:
+    """(reference: placers/utils.py:385-392)"""
+    shapes = block_shapes_for(factor_pairs(num_servers), meta_shape)
+    shapes += [(num_servers, num_servers, -1), (num_servers, 1, 1)]
+    return first_fit_block(shapes, meta_shape, ramp_shape, ramp, job_idx,
+                           op_size=op_size)
+
+
+def find_meta_block(ramp: Dict[Coord, dict],
+                    ramp_shape: Coord,
+                    meta_shape: Coord):
+    """First fully-free block of ``meta_shape``; returns (servers, shape,
+    origin) or None (reference: placers/utils.py:117-191)."""
+    span = (ramp_shape[0] - meta_shape[0] + 1,
+            ramp_shape[1] - meta_shape[1] + 1,
+            ramp_shape[2] - meta_shape[2] + 1)
+    if span[0] <= 0 or span[1] <= 0 or span[2] <= 0:
+        return None
+    # meta-mode scans the whole ramp extent (reference: utils.py:176-179)
+    for i in range(ramp_shape[0]):
+        for j in range(ramp_shape[1]):
+            for k in range(ramp_shape[2]):
+                block = enumerate_block(meta_shape, ramp_shape, (i, j, k))
+                if block_ok(ramp, block, None, job_idx="__meta__"):
+                    return block, meta_shape, (i, j, k)
+    return None
+
+
+def meta_block_shape_valid(c: int, r: int, s: int,
+                           ramp: Dict[Coord, dict],
+                           ramp_shape: Coord,
+                           job_max_partition_degree: int,
+                           num_available_workers: int) -> bool:
+    """Validity of a (c, r, s) meta-block action for a job with the given
+    max partition degree (reference: placers/utils.py:13-30)."""
+    size = c * r * s
+    if not (job_max_partition_degree <= size
+            <= min(num_available_workers, job_max_partition_degree)):
+        return False
+    if size == job_max_partition_degree and c != r:
+        # exact-size blocks must pack evenly across racks and comm groups
+        return False
+    return find_meta_block(ramp, ramp_shape, (c, r, s)) is not None

@@ -1,0 +1,287 @@
+"""Op and dependency placers.
+
+:class:`RampFirstFitOpPlacer` -- the RAMP packing heuristic (reference:
+agents/placers/ramp_first_fit_op_placer.py:23 + placers/utils.py:532): walk
+the job's forward ops in topological order; for each op try *parent
+co-location* (pack sub-ops onto exactly the servers its parent occupies) and
+fall back to a *regular* symmetric sub-block search; forward and backward
+sub-ops are always placed together on the same server. A failed op fails the
+whole job (it is simply absent from the returned placement, which blocks it).
+
+:class:`FirstFitDepPlacer` -- routes every cross-server nonzero dep over the
+first (shortest path x channel) combination whose channels carry no other
+job; one unroutable flow drops the whole job
+(reference: agents/placers/first_fit_dep_placer.py:18).
+"""
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ddls_tpu.agents.block_search import (Coord, find_sub_block,
+                                          snapshot_free_servers)
+from ddls_tpu.graphs.readers import backward_op_id
+from ddls_tpu.hardware.devices import channel_id as make_channel_id
+from ddls_tpu.sim.partition import partitioned_op_id
+
+
+def _pair_memory(full_graph, op: str, b_op: str) -> float:
+    """Combined memory of a forward op and its backward counterpart: both are
+    mounted on the same server, so the placer must reserve both (the
+    reference reserves only the forward op's memory,
+    placers/utils.py:296-312, and can hand the cluster a placement that
+    overflows a worker at mount time; accounting for both here keeps
+    placements mountable by construction)."""
+    mem = full_graph.memory_cost(op)
+    if full_graph.has_op(b_op):
+        mem += full_graph.memory_cost(b_op)
+    return mem
+
+
+def _try_parent_colocation(ramp, full_graph, op: str, split: int,
+                           meta_servers: Set[Coord], parents: List[str],
+                           op_to_servers: Dict[str, List[Coord]],
+                           n_forward: int,
+                           placed: Dict[str, Coord]) -> bool:
+    """Pack the op's sub-ops one-per-server onto a parent's exact server set
+    (reference: placers/utils.py:258-314). Requires split == number of parent
+    servers and per-server free memory for each fwd+bwd sub-op pair."""
+    b_op = backward_op_id(op, n_forward)
+    per_server = _pair_memory(full_graph, op, b_op) / split
+    for parent in parents:
+        servers = op_to_servers.get(parent, [])
+        if not servers or not set(servers).issubset(meta_servers):
+            continue
+        if split != len(servers):
+            continue
+        if any(ramp[s]["mem"] < per_server for s in servers):
+            continue
+        for i, server in enumerate(servers):
+            ramp[server]["mem"] -= per_server
+            if split > 1:
+                placed[partitioned_op_id(op, i)] = server
+                placed[partitioned_op_id(b_op, i)] = server
+            else:
+                placed[str(int(op))] = server
+                placed[str(int(b_op))] = server
+            op_to_servers.setdefault(op, []).append(server)
+        return True
+    return False
+
+
+def _try_regular_placement(ramp, ramp_shape, full_graph, op: str, split: int,
+                           meta_shape: Coord, op_to_servers, n_forward: int,
+                           job_idx, placed: Dict[str, Coord]) -> bool:
+    """Symmetric sub-block placement, one sub-op per server
+    (reference: placers/utils.py:333-383)."""
+    b_op = backward_op_id(op, n_forward)
+    op_size = _pair_memory(full_graph, op, b_op) / split
+    block = find_sub_block(ramp, ramp_shape, meta_shape, num_servers=split,
+                           op_size=op_size, job_idx=job_idx)
+    if not block:
+        return False
+    for j, server in enumerate(block):
+        ramp[server]["mem"] -= op_size
+        if split > 1:
+            placed[partitioned_op_id(op, j)] = server
+            placed[partitioned_op_id(b_op, j)] = server
+        else:
+            placed[str(int(op))] = server
+            placed[str(int(b_op))] = server
+        op_to_servers.setdefault(op, []).append(server)
+    return True
+
+
+def allocate_job(ramp, ramp_shape: Coord, forward_graph, full_graph,
+                 split_fwd: Dict[str, int],
+                 meta_servers: Set[Coord], meta_shape: Coord,
+                 job_idx) -> Optional[Dict[str, Coord]]:
+    """Allocate every (sub-)op of one job; returns op_id -> server coord or
+    None on failure (reference: placers/utils.py:532 allocate)."""
+    n_forward = len(forward_graph.op_ids)
+    parents = {op: forward_graph.parents(op) for op in forward_graph.op_ids}
+    op_to_servers: Dict[str, List[Coord]] = {}
+    placed: Dict[str, Coord] = {}
+    for op in forward_graph.topo_order():
+        split = split_fwd.get(str(int(op)), 1)
+        ok = _try_parent_colocation(ramp, full_graph, op, split,
+                                    meta_servers, parents[op], op_to_servers,
+                                    n_forward, placed)
+        if not ok:
+            ok = _try_regular_placement(ramp, ramp_shape, full_graph, op,
+                                        split, meta_shape, op_to_servers,
+                                        n_forward, job_idx, placed)
+        if not ok:
+            return None
+    return placed
+
+
+class RampFirstFitOpPlacer:
+    def __init__(self, **kwargs):
+        pass
+
+    def get(self, op_partition, cluster, meta_block_shapes: Optional[dict] = None,
+            verbose: bool = False):
+        """``meta_block_shapes`` optionally restricts each job to a chosen
+        (c, r, s) meta block (the placement-shaping MDP's action); default is
+        the whole cluster (reference: ramp_first_fit_op_placer.py:80-86)."""
+        from ddls_tpu.sim.actions import OpPlacement
+
+        topo = cluster.topology
+        ramp_shape = topo.shape
+        ramp = snapshot_free_servers(cluster)
+        placement: Dict[int, Dict[str, str]] = {}
+
+        for job_id in op_partition.action:
+            original = op_partition.original_jobs[job_id]
+            job_idx = original.details["job_idx"]
+            forward_graph = original.graph.forward_view()
+            split_fwd = op_partition.job_id_to_split_forward_ops[job_id]
+
+            if meta_block_shapes and job_id in meta_block_shapes:
+                from ddls_tpu.agents.block_search import find_meta_block
+
+                meta = find_meta_block(ramp, ramp_shape,
+                                       meta_block_shapes[job_id])
+                if meta is None:
+                    continue
+                meta_servers, meta_shape = set(meta[0]), meta[1]
+            else:
+                meta_servers = {topo.parse_server_id(s)
+                                for s in topo.server_ids}
+                meta_shape = ramp_shape
+
+            placed = allocate_job(ramp, ramp_shape, forward_graph,
+                                  original.graph, split_fwd,
+                                  meta_servers, meta_shape, job_idx)
+            if placed is None:
+                continue
+            op_to_worker = {}
+            for op_id, coord in placed.items():
+                server_id = f"{coord[0]}-{coord[1]}-{coord[2]}"
+                # RAMP currently assumes 1 worker per server
+                worker_id = topo.server_to_workers[server_id][0]
+                op_to_worker[str(op_id)] = worker_id
+            placement[job_id] = op_to_worker
+            # mark servers as occupied by this job for subsequent jobs in the
+            # same step
+            for coord in placed.values():
+                ramp[coord]["job_idxs"].add(job_idx)
+
+        return OpPlacement(placement, op_partition=op_partition,
+                           cluster=cluster)
+
+
+class RandomOpPlacer:
+    """Random valid worker per op, respecting memory and the one-job-per-
+    worker rule (reference: agents/placers/random_op_placer.py:13).
+
+    Unlike the first-fit placer this ignores collective symmetry, so jobs it
+    places may price collectives pessimistically."""
+
+    def __init__(self, **kwargs):
+        pass
+
+    def get(self, op_partition, cluster, verbose: bool = False):
+        from ddls_tpu.sim.actions import OpPlacement
+
+        topo = cluster.topology
+        placement: Dict[int, Dict[str, str]] = {}
+        free_mem = {wid: w.memory_free for wid, w in topo.workers.items()}
+        occupied = {wid: set(w.mounted_job_idx_to_ops)
+                    for wid, w in topo.workers.items()}
+        for job_id, partitioned in op_partition.partitioned_jobs.items():
+            job_idx = partitioned.details["job_idx"]
+            op_to_worker: Dict[str, str] = {}
+            ok = True
+            for op_id in partitioned.graph.op_ids:
+                mem = partitioned.graph.memory_cost(op_id)
+                candidates = [
+                    wid for wid in topo.workers
+                    if free_mem[wid] >= mem
+                    and (not occupied[wid] or occupied[wid] == {job_idx})]
+                if not candidates:
+                    ok = False
+                    break
+                wid = random.choice(candidates)
+                op_to_worker[op_id] = wid
+                free_mem[wid] -= mem
+                occupied[wid].add(job_idx)
+            if ok:
+                placement[job_id] = op_to_worker
+        return OpPlacement(placement, op_partition=op_partition,
+                           cluster=cluster)
+
+
+class FirstFitDepPlacer:
+    def __init__(self, **kwargs):
+        pass
+
+    def get(self, op_partition, op_placement, cluster, verbose: bool = False):
+        from ddls_tpu.sim.actions import DepPlacement
+
+        topo = cluster.topology
+        placements = op_placement.action
+        result: Dict[int, Dict[Tuple[str, str], Set[Optional[str]]]] = {}
+        channels_used_by_other_jobs: Set[str] = set()
+
+        for job_id, partitioned in op_partition.partitioned_jobs.items():
+            if job_id not in placements:
+                continue
+            job_idx = partitioned.details["job_idx"]
+            dep_to_channels: Dict[Tuple[str, str], Set[Optional[str]]] = (
+                defaultdict(set))
+            channels_this_job: Set[str] = set()
+            ok = True
+            for dep_id in partitioned.graph.edge_ids:
+                u, v = dep_id
+                src_node = topo.worker_to_server[placements[job_id][u]]
+                dst_node = topo.worker_to_server[placements[job_id][v]]
+                size = partitioned.graph.edge_size(u, v)
+                if src_node == dst_node or size == 0:
+                    dep_to_channels[dep_id].add(None)
+                    continue
+                found = self._first_valid_path_channel(
+                    topo, src_node, dst_node, job_idx,
+                    channels_used_by_other_jobs)
+                if found is None:
+                    ok = False
+                    break
+                path, ch_num = found
+                for idx in range(len(path) - 1):
+                    ch_id = make_channel_id(path[idx], path[idx + 1], ch_num)
+                    dep_to_channels[dep_id].add(ch_id)
+                    channels_this_job.add(ch_id)
+            if ok:
+                result[job_id] = dict(dep_to_channels)
+                channels_used_by_other_jobs.update(channels_this_job)
+        return DepPlacement(result)
+
+    def _first_valid_path_channel(self, topo, src_node: str, dst_node: str,
+                                  job_idx: int,
+                                  channels_used_by_other_jobs: Set[str]):
+        paths = topo.shortest_paths[src_node][dst_node]
+        channel_nums = list(range(topo.num_channels))
+        # shuffle so a job's flows spread over channels
+        # (reference: first_fit_dep_placer.py:118-121)
+        random.shuffle(channel_nums)
+        for path in paths:
+            for ch_num in channel_nums:
+                if self._path_channel_valid(topo, path, ch_num, job_idx,
+                                            channels_used_by_other_jobs):
+                    return path, ch_num
+        return None
+
+    def _path_channel_valid(self, topo, path, ch_num: int, job_idx: int,
+                            channels_used_by_other_jobs: Set[str]) -> bool:
+        for idx in range(len(path) - 1):
+            ch_id = make_channel_id(path[idx], path[idx + 1], ch_num)
+            channel = topo.channel_id_to_channel[ch_id]
+            if job_idx in channel.mounted_job_idx_to_deps:
+                continue
+            if channel.mounted_job_idx_to_deps:
+                return False
+            if ch_id in channels_used_by_other_jobs:
+                return False
+        return True

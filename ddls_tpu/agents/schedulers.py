@@ -1,0 +1,72 @@
+"""SRPT op and dep schedulers.
+
+Shortest-remaining-processing-time priorities: sort the new job's ops per
+worker (resp. flow deps globally) by run time *descending* and assign
+ascending priority indices, so the shortest item carries the highest priority
+number; the lookahead engine picks the max-priority ready item
+(reference: agents/schedulers/srpt_op_scheduler.py:14,
+srpt_dep_scheduler.py:12).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict
+
+
+class SRPTOpScheduler:
+    def __init__(self, **kwargs):
+        pass
+
+    def get(self, op_partition, op_placement, cluster):
+        from ddls_tpu.sim.actions import OpSchedule
+
+        action: Dict[str, Dict[int, Dict[str, int]]] = defaultdict(
+            lambda: defaultdict(dict))
+        if not op_placement.action:
+            return OpSchedule({})
+        for worker_id, ops in op_placement.worker_to_ops.items():
+            costed = []
+            for entry in ops:
+                job = op_partition.partitioned_jobs[entry["job_id"]]
+                cost = job.graph.compute_cost(entry["op_id"])
+                costed.append((entry["job_id"], entry["op_id"], cost))
+            costed.sort(key=lambda t: t[2], reverse=True)
+            for priority, (job_id, op_id, _) in enumerate(costed):
+                action[worker_id][job_id][op_id] = priority
+        return OpSchedule({k: dict(v) for k, v in action.items()})
+
+
+class SRPTDepScheduler:
+    def __init__(self, **kwargs):
+        pass
+
+    def get(self, op_partition, dep_placement, cluster):
+        from ddls_tpu.sim.actions import DepSchedule
+
+        if not dep_placement.action:
+            return DepSchedule({})
+        # global SRPT ordering over all newly placed flow deps, priced by the
+        # comm model (reference sorts all jobdeps together,
+        # srpt_dep_scheduler.py:66-77)
+        costed = []
+        for job_id, dep_to_channels in dep_placement.action.items():
+            job = op_partition.partitioned_jobs[job_id]
+            for dep_id in dep_to_channels:
+                cost = job.dep_init_run_time.get(dep_id, 0.0)
+                costed.append((job_id, dep_id, cost))
+        costed.sort(key=lambda t: t[2], reverse=True)
+
+        action: Dict[str, Dict[int, Dict[tuple, int]]] = defaultdict(
+            lambda: defaultdict(dict))
+        for priority, (job_id, dep_id, _) in enumerate(costed):
+            channels = dep_placement.jobdep_to_channels.get(
+                (job_id, dep_id), set())
+            if not channels:
+                # non-flow dep: keep it under the None channel so the job
+                # still counts as handled by this sub-action (the reference
+                # schedules non-flows onto a None channel key,
+                # srpt_dep_scheduler.py:57-63 + cluster :1404-1415)
+                action[None][job_id][dep_id] = priority
+            for ch_id in channels:
+                action[ch_id][job_id][dep_id] = priority
+        return DepSchedule({k: dict(v) for k, v in action.items()})

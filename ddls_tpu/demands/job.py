@@ -62,7 +62,8 @@ def compute_immutable_details(graph: OpGraph, num_training_steps: int) -> dict:
 class ExecState:
     """Flat-array execution state of one training step."""
 
-    def __init__(self, graph: OpGraph):
+    def __init__(self, graph: OpGraph,
+                 dep_init_run_times: Optional[Dict[EdgeId, float]] = None):
         arrays = graph.finalize()
         self.graph = graph
         self.op_index: Dict[str, int] = arrays["op_index"]
@@ -89,6 +90,9 @@ class ExecState:
         self.deps_ready: Set[int] = set()
         self.n_ops_completed = 0
         self.n_deps_completed = 0
+        if dep_init_run_times:
+            for edge, t in dep_init_run_times.items():
+                self.set_dep_init_run_time(edge, t)
 
     # ------------------------------------------------------------------ events
     def set_dep_init_run_time(self, edge: EdgeId, run_time: float) -> None:
@@ -186,6 +190,10 @@ class Job:
 
         self.reset_mutable_details()
         self.state: Optional[ExecState] = None
+        # per-edge placed communication times, set by the comm model after op
+        # placement; survives training-step resets (the reference keeps
+        # these as edge 'init_run_time' attributes, job.py:461-464)
+        self.dep_init_run_time: Dict[EdgeId, float] = {}
         self.training_step_counter = 0
         self.original_job = original_job if original_job is not None else self
 
@@ -198,8 +206,13 @@ class Job:
         self.details["mounted_channels"] = set()
 
     def reset_training_step(self) -> ExecState:
-        self.state = ExecState(self.graph)
+        self.state = ExecState(self.graph, self.dep_init_run_time)
         return self.state
+
+    def set_dep_init_run_time(self, edge: EdgeId, run_time: float) -> None:
+        self.dep_init_run_time[edge] = float(run_time)
+        if self.state is not None:
+            self.state.set_dep_init_run_time(edge, run_time)
 
     def register_arrived(self, time_arrived: float, job_idx: int) -> None:
         self.details["time_arrived"] = time_arrived

@@ -1,0 +1,351 @@
+"""Composite cluster actions: partition, placement, scheduling decisions.
+
+A cluster step consumes an :class:`Action` bundling five sub-decisions
+(reference: ddls/environments/ramp_cluster/actions/):
+
+* :class:`OpPartition`   -- job -> op -> num_partitions; builds partitioned Jobs
+* :class:`OpPlacement`   -- job -> op -> worker; prices dependency run times
+* :class:`OpSchedule`    -- worker -> job -> op -> priority
+* :class:`DepPlacement`  -- job -> dep -> channel ids
+* :class:`DepSchedule`   -- channel -> job -> dep -> priority
+
+``Action`` keeps only jobs handled by *all* sub-actions and records which
+sub-action dropped a job (the blocking cause)
+(reference: actions/action.py:36-78).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ddls_tpu.demands.job import Job
+from ddls_tpu.graphs.readers import backward_op_id
+from ddls_tpu.sim.comm_model import one_to_one_time, ramp_all_reduce_time
+from ddls_tpu.sim.partition import partition_graph, partitioned_op_id
+
+EdgeId = Tuple[str, str]
+
+
+class OpPartition:
+    """(reference: actions/op_partition.py:8)"""
+
+    def __init__(self, action: Dict[int, Dict[str, int]], cluster):
+        self.action = {job_id: dict(ops) for job_id, ops in action.items()}
+        self.job_ids: Set[int] = set(self.action)
+        self.original_jobs: Dict[int, Job] = {}
+        self.partitioned_jobs: Dict[int, Job] = {}
+        self.job_id_to_max_partition_degree: Dict[int, int] = defaultdict(lambda: 1)
+        self.job_id_to_split_forward_ops: Dict[int, Dict[str, int]] = {}
+
+        for job_id, op_to_n in self.action.items():
+            for op_id, n in op_to_n.items():
+                if n != 1 and n % 2 != 0:
+                    raise ValueError(
+                        f"job {job_id} op {op_id}: num_partitions must be 1 "
+                        f"or even, got {n}")
+
+        for job_id in self.action:
+            job = cluster.job_queue.jobs[job_id]
+            self.original_jobs[job_id] = job
+
+            # forward split map in graph order
+            split_fwd: Dict[str, int] = {}
+            max_degree = 1
+            for op in job.graph.forward_op_ids():
+                n = int(self.action[job_id].get(str(int(op)), 1))
+                if n > 1:
+                    split_fwd[str(int(op))] = n
+                    max_degree = max(max_degree, n)
+            self.job_id_to_split_forward_ops[job_id] = split_fwd
+            self.job_id_to_max_partition_degree[job_id] = max_degree
+
+            # memoised partitioned graph + immutable details. The reference
+            # keys by (model, max partition degree)
+            # (op_partition.py:44-66 + cluster memo tables) which is unsound
+            # for partitioners that vary the per-op split map at a fixed max
+            # degree (e.g. random); key on the full split map instead -- the
+            # SiP-ML/PAC-ML path still hits because its map is a pure
+            # function of (model, degree, quantum).
+            model = job.details["model"]
+            cache_key = (model, tuple(sorted(split_fwd.items())))
+            cached = cluster.partition_cache.get(cache_key)
+            if cached is None:
+                pgraph = partition_graph(job.graph, self.action[job_id])
+                cached = {"graph": pgraph, "immutable": None}
+                cluster.partition_cache[cache_key] = cached
+            pgraph = cached["graph"]
+
+            details = {"model": model,
+                       "job_idx": job.details.get("job_idx"),
+                       "time_arrived": job.details.get("time_arrived"),
+                       "max_partitions_per_op": max_degree}
+            partitioned = Job(graph=pgraph,
+                              num_training_steps=job.num_training_steps,
+                              max_acceptable_jct_frac=job.max_acceptable_jct_frac,
+                              job_id=job_id,
+                              details=details,
+                              immutable_details=cached["immutable"],
+                              original_job=job)
+            if cached["immutable"] is None:
+                cached["immutable"] = partitioned.immutable
+            self.partitioned_jobs[job_id] = partitioned
+
+    def __len__(self) -> int:
+        return len(self.action)
+
+
+class OpPlacement:
+    """job -> op -> worker map; prices all dependency run times on
+    construction (reference: actions/op_placement.py:7 + actions/utils.py:13
+    update_dep_run_times)."""
+
+    def __init__(self, action: Dict[int, Dict[str, str]],
+                 op_partition: OpPartition, cluster):
+        self.action = {job_id: dict(ops) for job_id, ops in action.items()}
+        self.job_ids: Set[int] = set(self.action)
+        self.worker_to_ops: Dict[str, List[dict]] = defaultdict(list)
+        self.job_id_to_worker_ids: Dict[int, Set[str]] = defaultdict(set)
+        for job_id, op_to_worker in self.action.items():
+            for op_id, worker_id in op_to_worker.items():
+                self.worker_to_ops[worker_id].append(
+                    {"op_id": op_id, "job_id": job_id})
+                self.job_id_to_worker_ids[job_id].add(worker_id)
+
+        assign_dep_run_times(cluster, op_partition, self)
+
+
+class OpSchedule:
+    """(reference: actions/op_schedule.py:3)"""
+
+    def __init__(self, action: Dict[str, Dict[int, Dict[str, int]]]):
+        self.action = action
+        self.job_ids: Set[int] = set()
+        for worker_id in self.action:
+            self.job_ids.update(self.action[worker_id].keys())
+
+
+class DepPlacement:
+    """job -> dep -> set(channel ids); None channel means not a flow
+    (reference: actions/dep_placement.py:6)."""
+
+    def __init__(self, action: Dict[int, Dict[EdgeId, Set[Optional[str]]]]):
+        self.action = action
+        self.job_ids: Set[int] = set(self.action)
+        self.jobdep_to_channels: Dict[Tuple[int, EdgeId], Set[str]] = {}
+        for job_id, dep_to_channels in self.action.items():
+            for dep_id, channels in dep_to_channels.items():
+                real = {c for c in channels if c is not None}
+                self.jobdep_to_channels[(job_id, dep_id)] = real
+
+
+class DepSchedule:
+    """(reference: actions/dep_schedule.py:3)"""
+
+    def __init__(self, action: Dict[str, Dict[int, Dict[EdgeId, int]]]):
+        self.action = action
+        self.job_ids: Set[int] = set()
+        for channel_id in self.action:
+            self.job_ids.update(self.action[channel_id].keys())
+
+
+class Action:
+    """Bundle of the five sub-actions; a job survives only if every
+    sub-action handled it (reference: actions/action.py:3)."""
+
+    SUB_ACTIONS = ("op_partition", "op_placement", "op_schedule",
+                   "dep_placement", "dep_schedule")
+
+    def __init__(self,
+                 op_partition: Optional[OpPartition] = None,
+                 op_placement: Optional[OpPlacement] = None,
+                 op_schedule: Optional[OpSchedule] = None,
+                 dep_placement: Optional[DepPlacement] = None,
+                 dep_schedule: Optional[DepSchedule] = None):
+        self.actions = {
+            "op_partition": op_partition,
+            "op_placement": op_placement,
+            "op_schedule": op_schedule,
+            "dep_placement": dep_placement,
+            "dep_schedule": dep_schedule,
+        }
+        present = {k: a for k, a in self.actions.items() if a is not None}
+        self.cause_of_unsuccessful_handling: Optional[str] = None
+        if present:
+            self.job_ids = set.intersection(
+                *[set(a.job_ids) for a in present.values()])
+            for key, act in present.items():
+                if not act.job_ids:
+                    self.cause_of_unsuccessful_handling = key
+                    break
+            self.job_idxs = {
+                op_partition.partitioned_jobs[j].details["job_idx"]
+                for j in self.job_ids} if op_partition is not None else set()
+        else:
+            self.job_ids = set()
+            self.job_idxs = set()
+
+        # filter unhandled jobs out of every sub-action
+        for key, act in present.items():
+            if key in ("op_partition", "op_placement", "dep_placement"):
+                for job_id in list(act.action):
+                    if job_id not in self.job_ids:
+                        del act.action[job_id]
+            else:  # schedules keyed by device
+                for device_id in act.action:
+                    for job_id in list(act.action[device_id]):
+                        if job_id not in self.job_ids:
+                            del act.action[device_id][job_id]
+
+
+# --------------------------------------------------------------- dep run times
+def group_collectives(original_job: Job,
+                      partitioned_job: Job,
+                      split_fwd_ops: Dict[str, int]):
+    """Group the partitioned job's deps into collectives and one-to-one
+    communications (reference: actions/utils.py:247-393).
+
+    For each original forward op f (and its backward counterpart b):
+
+    * f split n ways: out-edges of the f sub-ops form a *candidate* forward
+      collective; non-sync in-edges of the b sub-ops a candidate backward
+      collective; the bidirectional sync pairs between b sub-ops are each a
+      2-edge collective.
+    * f unsplit: out-edges of f and in-edges of b are one-to-one.
+
+    Whether a candidate group is a real collective depends on placement
+    symmetry, checked later. Each dep is claimed exactly once, first claim
+    wins (the reference double-visits the fwd->bwd join edge when the last
+    forward op is split and would trip its own conservation check;
+    deterministic first-claim avoids that while preserving grouping for all
+    other edges).
+
+    Returns (candidate_groups, sync_groups, one_to_one) where candidate
+    groups still need the placement symmetry test.
+    """
+    graph = partitioned_job.graph
+    n_fwd = len(original_job.graph.forward_op_ids())
+    claimed: Set[EdgeId] = set()
+    candidate_groups: List[List[EdgeId]] = []
+    sync_groups: List[List[EdgeId]] = []
+    one_to_one: List[EdgeId] = []
+
+    def claim(edges: List[EdgeId]) -> List[EdgeId]:
+        fresh = [e for e in edges if e not in claimed]
+        claimed.update(fresh)
+        return fresh
+
+    for f_op in original_job.graph.forward_op_ids():
+        f_op = str(int(f_op))
+        b_op = backward_op_id(f_op, n_fwd)
+        if f_op in split_fwd_ops:
+            n = split_fwd_ops[f_op]
+            fwd_deps: List[EdgeId] = []
+            bwd_deps: List[EdgeId] = []
+            sync_pairs: List[List[EdgeId]] = []
+            seen_sync: Set[frozenset] = set()
+            for i in range(n):
+                f_sub = partitioned_op_id(f_op, i)
+                fwd_deps.extend(graph.out_edges(f_sub))
+                b_sub = partitioned_op_id(b_op, i)
+                for (u, v) in graph.in_edges(b_sub):
+                    if u in graph.successors(v):
+                        key = frozenset((u, v))
+                        if key not in seen_sync:
+                            seen_sync.add(key)
+                            sync_pairs.append([(u, v), (v, u)])
+                    else:
+                        bwd_deps.append((u, v))
+            fwd_deps = claim(fwd_deps)
+            if fwd_deps:
+                candidate_groups.append(fwd_deps)
+            bwd_deps = claim(bwd_deps)
+            if bwd_deps:
+                candidate_groups.append(bwd_deps)
+            for pair in sync_pairs:
+                pair = claim(pair)
+                if pair:
+                    sync_groups.append(pair)
+        else:
+            one_to_one.extend(claim(graph.out_edges(f_op)))
+            one_to_one.extend(claim(graph.in_edges(b_op)))
+
+    total = (sum(len(g) for g in candidate_groups)
+             + sum(len(g) for g in sync_groups) + len(one_to_one))
+    if total != graph.n_deps:
+        raise RuntimeError(
+            f"collective grouping covered {total} of {graph.n_deps} deps of "
+            f"job {partitioned_job.job_id}; grouping bug")
+    return candidate_groups, sync_groups, one_to_one
+
+
+def assign_dep_run_times(cluster, op_partition: OpPartition,
+                         op_placement: "OpPlacement") -> None:
+    """Price every dep of every placed job given op placements and topology
+    (reference: actions/utils.py:13-167)."""
+    if not op_placement.job_ids:
+        return
+    topo = cluster.topology
+    for job_id in op_partition.action:
+        if job_id not in op_placement.action:
+            continue
+        original = op_partition.original_jobs[job_id]
+        partitioned = op_partition.partitioned_jobs[job_id]
+        placement = op_placement.action[job_id]
+        split_fwd = op_partition.job_id_to_split_forward_ops[job_id]
+
+        candidate_groups, sync_groups, o2o = group_collectives(
+            original, partitioned, split_fwd)
+
+        def server_of(op_id: str) -> str:
+            return topo.worker_to_server[placement[op_id]]
+
+        collectives: List[List[EdgeId]] = list(sync_groups)
+        for group in candidate_groups:
+            # placement-symmetric parent/child multisets -> true collective
+            parent_servers = sorted(server_of(u) for u, _ in group)
+            child_servers = sorted(server_of(v) for _, v in group)
+            if parent_servers == child_servers:
+                collectives.append(group)
+            else:
+                o2o = o2o + group
+
+        for group in collectives:
+            servers = set()
+            message_size = 0.0
+            for u, v in group:
+                servers.add(server_of(u))
+                servers.add(server_of(v))
+                message_size += partitioned.graph.edge_size(u, v)
+            if len(servers) == 1:
+                run_time = 0.0
+            else:
+                cgs, racks, srv_ids = set(), set(), set()
+                for sid in servers:
+                    c, r, s = sid.split("-")
+                    cgs.add(c)
+                    racks.add(r)
+                    srv_ids.add(s)
+                run_time = ramp_all_reduce_time(
+                    message_size=message_size,
+                    num_servers=len(srv_ids),
+                    num_racks=len(racks),
+                    num_comm_groups=len(cgs),
+                    network_comm_groups=topo.num_communication_groups,
+                    data_rate=topo.channel_bandwidth,
+                    propagation_latency=topo.intra_gpu_propagation_latency,
+                    io_latency=topo.worker_io_latency)
+            for dep in group:
+                partitioned.set_dep_init_run_time(dep, run_time)
+
+        for (u, v) in o2o:
+            if server_of(u) == server_of(v):
+                run_time = 0.0
+            elif partitioned.graph.edge_size(u, v) == 0:
+                run_time = 0.0
+            else:
+                run_time = one_to_one_time(
+                    partitioned.graph.edge_size(u, v),
+                    data_rate=topo.channel_bandwidth,
+                    propagation_latency=topo.intra_gpu_propagation_latency,
+                    io_latency=topo.worker_io_latency)
+            partitioned.set_dep_init_run_time((u, v), run_time)

@@ -1,0 +1,851 @@
+"""The RAMP cluster discrete-event simulator.
+
+Counterpart of the reference's ``RampClusterEnvironment``
+(ddls/environments/ramp_cluster/ramp_cluster_environment.py:74). Key design,
+identical in spirit: because RAMP's validity rules guarantee no contention
+(at most one job per worker and per channel), a job's completion time can be
+computed *once* when it is mounted by an internal lookahead simulation of a
+single training step (``_run_lookahead``, reference :379); the outer event
+loop then only advances wall-clock time between {job arrival, job completion,
+simulation end} events (reference step :894).
+
+Lookahead tick semantics (reference :379-467):
+
+1. on each worker holding the job, select the highest-priority *ready* op;
+   the shortest remaining run time among selected ops bounds the tick;
+2. ready deps that never became flows (zero size, or same source/destination
+   server) complete at zero cost and suppress flow consideration this tick;
+3. otherwise the highest-priority ready dep per channel is found, channel
+   contention is resolved in favour of the highest priority contender, and
+   the shortest remaining communication time bounds the tick;
+4. tick = min(op bound, dep bound); selected ops are ticked, and -- matching
+   the reference's documented simplification (:756) -- *all* ready flow deps
+   are ticked in parallel regardless of schedule;
+5. communication/computation overlap is accounted per tick (:777).
+
+Memoisation: lookahead results and partitioned graphs are cached per
+(model, max partition degree) -- this cache is what makes episodes cheap
+(reference :269-277, :469-506).
+
+Deviation from the reference (documented): channel-contention losers are
+chosen against the best *contending* priority rather than the global maximum
+of all priority deps (reference :642 takes a global argmax, which can delete
+non-contending deps); this only affects tick granularity, never which deps
+ultimately transfer.
+"""
+from __future__ import annotations
+
+import gzip
+import math
+import pathlib
+import pickle
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ddls_tpu.demands.job import Job
+from ddls_tpu.demands.job_queue import JobQueue
+from ddls_tpu.demands.jobs_generator import JobsGenerator
+from ddls_tpu.hardware.topologies import build_topology
+from ddls_tpu.utils import Stopwatch, seed_everything, unique_experiment_dir
+
+EdgeId = Tuple[str, str]
+
+
+class RampClusterEnvironment:
+    def __init__(self,
+                 topology_config: dict,
+                 node_config: dict,
+                 name: str = "ramp_cluster",
+                 path_to_save: Optional[str] = None,
+                 save_freq: int = 1,
+                 use_sqlite_database: bool = False,  # accepted for config parity
+                 suppress_warnings: bool = True,
+                 machine_epsilon: float = 1e-7):
+        self.name = name
+        self.machine_epsilon = machine_epsilon
+        self.suppress_warnings = suppress_warnings
+        self.save_freq = save_freq
+        self.path_to_save = (unique_experiment_dir(path_to_save, name)
+                             if path_to_save is not None else None)
+
+        self.topology_config = topology_config
+        self.node_config = node_config
+        self.topology = build_topology(topology_config)
+        self.topology.populate_workers(node_config)
+
+        self.stopwatch = Stopwatch()
+        self.reset_counter = 0
+        self._save_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ reset
+    def reset(self,
+              jobs_config,
+              max_simulation_run_time: float = float("inf"),
+              job_queue_capacity: int = 10,
+              seed: Optional[int] = None,
+              verbose: bool = False):
+        self.reset_counter += 1
+        if seed is not None:
+            seed_everything(seed)
+        self.seed = seed
+        self.stopwatch.reset()
+
+        if isinstance(jobs_config, JobsGenerator):
+            self.jobs_generator = jobs_config
+        else:
+            self.jobs_generator = JobsGenerator(**jobs_config)
+        self.max_simulation_run_time = (
+            float("inf") if max_simulation_run_time is None
+            else max_simulation_run_time)
+
+        self.topology.reset_devices()
+        self.job_queue = JobQueue(queue_capacity=job_queue_capacity)
+
+        self.num_jobs_arrived = 0
+        self.load_rates: List[float] = []
+        self.mounted_workers: Set[str] = set()
+        self.mounted_channels: Set[str] = set()
+        self.jobs_running: Dict[int, Job] = {}
+        self.jobs_completed: Dict[int, Job] = {}
+        self.jobs_blocked: Dict[int, Job] = {}
+        self.job_op_to_worker: Dict[Tuple[int, str], str] = {}
+        self.job_dep_to_channels: Dict[Tuple[int, EdgeId], Set[str]] = defaultdict(set)
+        self.job_id_to_job_idx: Dict[int, int] = {}
+        self.job_idx_to_job_id: Dict[int, int] = {}
+        self.job_op_placement: Dict[int, Dict[str, str]] = {}
+        self.job_dep_placement: Dict[int, Dict[EdgeId, Set[Optional[str]]]] = {}
+        self.step_counter = 0
+        self.action = None
+        self.op_partition = None
+
+        # memo caches keyed by (model, max partition degree); valid as long as
+        # partition degree fully determines the partitioned graph + schedule
+        # (reference warns about the same constraint, :269-277)
+        self.partition_cache: Dict[Tuple[str, int], dict] = {}
+        self.lookahead_cache: Dict[Tuple[str, int], tuple] = {}
+
+        self.steps_log = defaultdict(list)
+        self.episode_stats = self._init_episode_stats()
+        self.step_stats = self._init_step_stats()
+
+        # first arrival at t=0
+        self.time_next_job_to_arrive = 0.0
+        self.job_queue.add(self._get_next_job())
+        return None
+
+    def _init_step_stats(self) -> dict:
+        s = defaultdict(float)
+        s["step_counter"] = self.step_counter
+        s["step_start_time"] = self.stopwatch.time()
+        for key in ("mean_num_mounted_workers", "mean_num_mounted_channels",
+                    "mean_num_jobs_running", "mean_compute_overhead_frac",
+                    "mean_communication_overhead_frac",
+                    "mean_mounted_worker_utilisation_frac",
+                    "mean_cluster_worker_utilisation_frac"):
+            s[key] = []
+        for key in ("num_jobs_completed", "num_jobs_arrived",
+                    "num_jobs_blocked"):
+            s[key] = 0
+        return s
+
+    def _init_episode_stats(self) -> dict:
+        e = defaultdict(list)
+        e["num_jobs_arrived"] = 0
+        e["num_jobs_completed"] = 0
+        e["num_jobs_blocked"] = 0
+        e["episode_start_time"] = self.stopwatch.time()
+        return e
+
+    # ---------------------------------------------------------------- arrivals
+    def _get_next_job(self) -> Job:
+        job = self.jobs_generator.sample_job()
+        job_idx = self.num_jobs_arrived
+        job.register_arrived(time_arrived=self.stopwatch.time(), job_idx=job_idx)
+        time_last = self.stopwatch.time()
+        self.time_next_job_to_arrive += self.jobs_generator.sample_interarrival_time()
+        gap = self.time_next_job_to_arrive - time_last
+        if gap > 0 and math.isfinite(gap):
+            self.load_rates.append(
+                (job.immutable["job_total_op_memory_cost"]
+                 + job.immutable["job_total_dep_size"]) / gap)
+        if job_idx in self.job_idx_to_job_id or job.job_id in self.job_id_to_job_idx:
+            raise RuntimeError(
+                f"duplicate job idx {job_idx} / id {job.job_id}; ids must be "
+                "unique across the simulation")
+        self.job_idx_to_job_id[job_idx] = job.job_id
+        self.job_id_to_job_idx[job.job_id] = job_idx
+        self.num_jobs_arrived += 1
+        self.last_job_arrived_job_idx = job_idx
+        self.episode_stats["num_jobs_arrived"] += 1
+        return job
+
+    # ---------------------------------------------------------------- lookahead
+    def _run_lookahead(self, job: Job):
+        """Simulate one training step of a freshly mounted job; returns
+        (jct, comm_overhead, comp_overhead, tick_profile) where the first
+        three are scaled by num_training_steps and tick_profile is a list of
+        (active_workers, tick_size) for the single simulated step."""
+        job_idx = job.details["job_idx"]
+        state = job.reset_training_step()
+        graph = job.graph
+
+        workers_with_job = [
+            w for w in self.topology.workers.values()
+            if job_idx in w.mounted_job_idx_to_ops]
+        # channels holding this job's deps
+        channels_with_job = [
+            ch for ch in self.topology.channel_id_to_channel.values()
+            if job_idx in ch.mounted_job_idx_to_deps]
+
+        # precompute static per-tick structures (flow-ness, sorted op lists
+        # per worker with op indices, per-channel sorted dep indices) --
+        # these never change during the lookahead
+        is_flow = np.zeros(graph.n_deps, dtype=bool)
+        for ei, (u, v) in enumerate(state.edge_ids):
+            if graph.edge_size(u, v) == 0:
+                continue
+            src_w = self.job_op_to_worker[(job_idx, u)]
+            dst_w = self.job_op_to_worker[(job_idx, v)]
+            is_flow[ei] = (self.topology.worker_to_server[src_w]
+                           != self.topology.worker_to_server[dst_w])
+        worker_op_lists = [
+            [(state.op_index[op_id], w.op_priority.get((job_idx, op_id), 0))
+             for op_id in sorted(w.mounted_job_idx_to_ops[job_idx])]
+            for w in workers_with_job]
+        channel_dep_lists = [
+            (ch.channel_id,
+             [(state.edge_index[dep], ch.dep_priority.get((job_idx, dep), 0))
+              for dep in sorted(ch.mounted_job_idx_to_deps[job_idx])])
+            for ch in channels_with_job]
+
+        t = comm_oh = comp_oh = 0.0
+        tick_profile: List[Tuple[int, float]] = []
+        guard = 0
+        while True:
+            guard += 1
+            if guard > 1_000_000:
+                raise RuntimeError("lookahead failed to converge (engine bug)")
+
+            # 1. highest-priority ready op per worker
+            selected_ops: List[int] = []
+            for op_list in worker_op_lists:
+                best_i, best_pri = None, None
+                for oi, pri in op_list:
+                    if oi in state.ops_ready and (
+                            best_pri is None or pri > best_pri):
+                        best_i, best_pri = oi, pri
+                if best_i is not None:
+                    selected_ops.append(best_i)
+            shortest_op = min(
+                (state.remaining_op[i] for i in selected_ops),
+                default=float("inf"))
+
+            # 2. ready non-flow deps (zero size or same server) are free
+            non_flow = [ei for ei in state.deps_ready if not is_flow[ei]]
+
+            # 3. flow bound via per-channel priority deps + contention
+            if non_flow:
+                shortest_comm = 0.0
+            else:
+                channel_to_pri_dep: Dict[str, int] = {}
+                dep_to_pri: Dict[int, int] = {}
+                dep_to_channels: Dict[int, Set[str]] = defaultdict(set)
+                for ch_id, dep_list in channel_dep_lists:
+                    best_dep, best_pri = None, None
+                    for ei, pri in dep_list:
+                        if ei in state.deps_ready and (
+                                best_pri is None or pri > best_pri):
+                            best_dep, best_pri = ei, pri
+                    if best_dep is not None:
+                        channel_to_pri_dep[ch_id] = best_dep
+                        dep_to_pri[best_dep] = best_pri
+                        dep_to_channels[best_dep].add(ch_id)
+                # contention: among deps sharing a channel keep the highest
+                # priority one
+                for dep in list(dep_to_channels):
+                    if dep not in dep_to_channels:
+                        continue
+                    contenders = {dep}
+                    for ch_id in dep_to_channels[dep]:
+                        other = channel_to_pri_dep.get(ch_id)
+                        if other is not None and other != dep:
+                            contenders.add(other)
+                    if len(contenders) > 1:
+                        winner = max(contenders, key=lambda d: dep_to_pri[d])
+                        for loser in contenders - {winner}:
+                            for ch_id in dep_to_channels.get(loser, ()):
+                                channel_to_pri_dep.pop(ch_id, None)
+                            dep_to_pri.pop(loser, None)
+                            dep_to_channels.pop(loser, None)
+                shortest_comm = min(
+                    (state.remaining_dep[ei]
+                     for ei in channel_to_pri_dep.values()),
+                    default=float("inf"))
+
+            tick = min(shortest_op, shortest_comm)
+            if math.isinf(tick):
+                raise RuntimeError(
+                    f"infinite lookahead tick for job {job.job_id}: no ready "
+                    "ops or deps can progress (engine bug)")
+
+            # snapshot ready deps before op ticking so deps readied by op
+            # completions this tick are not advanced a step early
+            deps_snapshot = sorted(state.deps_ready,
+                                   key=lambda ei: state.edge_ids[ei])
+
+            ticked_ops = False
+            active_workers = 0
+            for oi in selected_ops:
+                state.tick_op(oi, tick)
+                ticked_ops = True
+                active_workers += 1
+
+            ticked_flows = False
+            if non_flow:
+                for ei in sorted(non_flow, key=lambda ei: state.edge_ids[ei]):
+                    state.tick_dep(ei, tick)
+            else:
+                for ei in deps_snapshot:
+                    state.tick_dep(ei, tick)
+                    ticked_flows = True
+
+            if ticked_ops and ticked_flows:
+                comm_oh += tick
+                comp_oh += tick
+            elif ticked_flows:
+                comm_oh += tick
+            elif ticked_ops:
+                comp_oh += tick
+
+            tick_profile.append((active_workers, tick))
+            t += tick
+
+            if state.is_training_step_complete():
+                job.training_step_counter += 1
+                break
+
+        steps = job.num_training_steps
+        return t * steps, comm_oh * steps, comp_oh * steps, tick_profile
+
+    def _lookahead_cache_key(self, job: Job, job_id: int) -> tuple:
+        """A signature that fully determines the lookahead outcome.
+
+        The reference memoises on (model, max partition degree) alone
+        (:269-277), which silently reuses results across *different
+        placements* of the same model. The outcome is exactly determined by
+        (a) the split map (hence the partitioned graph and its costs),
+        (b) which ops share a worker (canonicalised worker grouping -- all
+        workers are identical and servers are symmetric), and (c) the placed
+        per-dep communication times. Keying on those keeps the cache exact
+        while still collapsing the common repeated-placement case.
+        """
+        job_idx = job.details["job_idx"]
+        split = tuple(sorted(
+            self.op_partition.job_id_to_split_forward_ops[job_id].items()))
+        worker_to_group: Dict[str, int] = {}
+        groups = []
+        for op in job.graph.op_ids:
+            w = self.job_op_to_worker[(job_idx, op)]
+            groups.append(worker_to_group.setdefault(w, len(worker_to_group)))
+        dep_times = tuple(job.dep_init_run_time.get(e, 0.0)
+                          for e in job.graph.edge_ids)
+        return (job.details["model"], split, tuple(groups), dep_times)
+
+    def _perform_lookahead_job_completion_time(self, action) -> None:
+        for job_id in sorted(action.job_ids):
+            job_idx = self.job_id_to_job_idx[job_id]
+            job = self.jobs_running[job_idx]
+            key = self._lookahead_cache_key(job, job_id)
+            cached = self.lookahead_cache.get(key)
+            if cached is None:
+                cached = self._run_lookahead(job)
+                self.lookahead_cache[key] = cached
+            jct, comm_oh, comp_oh, tick_profile = cached
+            self._register_completed_lookahead(job, jct, comm_oh, comp_oh,
+                                               tick_profile)
+
+    def _register_completed_lookahead(self, job: Job, jct: float,
+                                      comm_oh: float, comp_oh: float,
+                                      tick_profile) -> None:
+        """(reference: :793-892)"""
+        if jct > job.max_acceptable_jct:
+            # SLA violated: block the original job, unmount the partitioned one
+            self._register_blocked_job(job.original_job)
+            self._remove_job_from_cluster(job)
+            return
+
+        n_mounted = max(len(job.details["mounted_workers"]), 1)
+        util = 0.0
+        for active, tick in tick_profile:
+            util += (active / n_mounted) * (tick / jct) if jct > 0 else 0.0
+
+        job.details["lookahead_job_completion_time"] = jct
+        job.details["communication_overhead_time"] = comm_oh
+        job.details["computation_overhead_time"] = comp_oh
+        job.details["mean_mounted_worker_utilisation_frac"] = util
+
+        # total size of deps that became flows (nonzero placed run time)
+        flow_size = 0.0
+        for edge, run_time in job.dep_init_run_time.items():
+            if run_time != 0:
+                flow_size += job.graph.edge_size(*edge)
+        job.details["job_total_flow_size"] = flow_size
+
+    # ------------------------------------------------------------------- step
+    def step(self, action, verbose: bool = False):
+        self.action = action
+        self.step_stats = self._init_step_stats()
+
+        # queued jobs not handled by every sub-action are blocked
+        for job_id, job in list(self.job_queue.jobs.items()):
+            if job_id not in action.job_ids:
+                self._register_blocked_job(job)
+
+        if action.actions["op_partition"] is not None:
+            self._partition_ops(action.actions["op_partition"])
+        if action.actions["op_placement"] is not None:
+            self._place_ops(action.actions["op_placement"])
+        if action.actions["op_schedule"] is not None:
+            self._schedule_ops(action.actions["op_schedule"])
+        if action.actions["dep_placement"] is not None:
+            self._place_deps(action.actions["dep_placement"])
+        if action.actions["dep_schedule"] is not None:
+            self._schedule_deps(action.actions["dep_schedule"])
+
+        self._perform_lookahead_job_completion_time(action)
+
+        # advance wall clock to the next event
+        step_done = False
+        while not step_done:
+            tick = min(self.time_next_job_to_arrive - self.stopwatch.time(),
+                       self.max_simulation_run_time - self.stopwatch.time())
+            for job in self.jobs_running.values():
+                elapsed = self.stopwatch.time() - job.details["time_started"]
+                remaining = (job.details["lookahead_job_completion_time"]
+                             - elapsed)
+                tick = min(tick, remaining)
+            tick = max(tick, 0.0)
+
+            self._accumulate_tick_stats(tick)
+            self.stopwatch.tick(tick)
+
+            completed = []
+            for job in self.jobs_running.values():
+                elapsed = self.stopwatch.time() - job.details["time_started"]
+                remaining = (job.details["lookahead_job_completion_time"]
+                             - elapsed - self.machine_epsilon)
+                if remaining <= 0:
+                    completed.append(job)
+                    step_done = True
+            for job in completed:
+                self._register_completed_job(job)
+
+            if len(self.jobs_generator) > 0:
+                if (self.stopwatch.time() + self.machine_epsilon
+                        >= self.time_next_job_to_arrive):
+                    nxt = self._get_next_job()
+                    self.step_stats["num_jobs_arrived"] += 1
+                    if self.job_queue.can_fit(nxt):
+                        self.job_queue.add(nxt)
+                    else:
+                        self._register_blocked_job(nxt)
+                    step_done = True
+            else:
+                self.time_next_job_to_arrive = float("inf")
+
+            if self.is_done():
+                step_done = True
+
+        self._finalise_step_stats()
+        self.step_counter += 1
+        if self.is_done():
+            self._finalise_episode_stats()
+        if self.path_to_save is not None and (
+                self.step_counter % self.save_freq == 0 or self.is_done()):
+            self.save()
+            if self.is_done() and self._save_thread is not None:
+                self._save_thread.join()
+        return None, None, None, self.is_done(), None
+
+    # ------------------------------------------------------------ sub-actions
+    def _partition_ops(self, op_partition) -> None:
+        self.op_partition = op_partition
+        for job_id in op_partition.action:
+            self.job_queue.jobs[job_id] = op_partition.partitioned_jobs[job_id]
+
+    def _place_ops(self, op_placement) -> None:
+        for job_id, op_to_worker in op_placement.action.items():
+            job = self.job_queue.jobs[job_id]
+            job_idx = job.details["job_idx"]
+            for op_id, worker_id in op_to_worker.items():
+                worker = self.topology.workers[worker_id]
+                # RAMP rule 1: at most one job per worker
+                other_jobs = set(worker.mounted_job_idx_to_ops) - {job_idx}
+                if other_jobs:
+                    raise RuntimeError(
+                        f"RAMP rule violation: worker {worker_id} already "
+                        f"holds job idx(s) {other_jobs}, cannot mount job "
+                        f"idx {job_idx}")
+                worker.mount(job, op_id)
+                job.details["mounted_workers"].add(worker_id)
+                self.job_op_to_worker[(job_idx, op_id)] = worker_id
+            self._register_running_job(job)
+            self.job_op_placement[job_id] = dict(op_to_worker)
+
+    def _register_running_job(self, job: Job) -> None:
+        job.register_running(time_started=self.stopwatch.time())
+        self.jobs_running[job.details["job_idx"]] = job
+        self.job_queue.remove(job)
+        # zero out non-flow dep run times now that placement is known
+        job_idx = job.details["job_idx"]
+        for u, v in job.graph.edge_ids:
+            if job.graph.edge_size(u, v) == 0:
+                job.set_dep_init_run_time((u, v), 0.0)
+            else:
+                src_w = self.job_op_to_worker[(job_idx, u)]
+                dst_w = self.job_op_to_worker[(job_idx, v)]
+                if (self.topology.worker_to_server[src_w]
+                        == self.topology.worker_to_server[dst_w]):
+                    job.set_dep_init_run_time((u, v), 0.0)
+                else:
+                    job.set_dep_init_run_time(
+                        (u, v), job.dep_init_run_time.get((u, v), 0.0))
+
+    def _schedule_ops(self, op_schedule) -> None:
+        for worker_id, job_to_ops in op_schedule.action.items():
+            worker = self.topology.workers[worker_id]
+            for job_id, op_to_pri in job_to_ops.items():
+                job_idx = self.job_id_to_job_idx[job_id]
+                for op_id, pri in op_to_pri.items():
+                    worker.op_priority[(job_idx, op_id)] = pri
+
+    def _place_deps(self, dep_placement) -> None:
+        for job_id, dep_to_channels in dep_placement.action.items():
+            job_idx = self.job_id_to_job_idx[job_id]
+            job = self.jobs_running[job_idx]
+            for dep_id, channels in dep_to_channels.items():
+                for ch_id in channels:
+                    if ch_id is None:
+                        continue
+                    channel = self.topology.channel_id_to_channel[ch_id]
+                    # RAMP rule 2: at most one job per channel
+                    others = set(channel.mounted_job_idx_to_deps) - {job_idx}
+                    if others:
+                        raise RuntimeError(
+                            f"RAMP rule violation: channel {ch_id} already "
+                            f"holds job idx(s) {others}")
+                    channel.mount(job, dep_id)
+                    job.details["mounted_channels"].add(ch_id)
+                    self.job_dep_to_channels[(job_idx, dep_id)].add(ch_id)
+            self.job_dep_placement[job_id] = dep_to_channels
+
+    def _schedule_deps(self, dep_schedule) -> None:
+        for ch_id, job_to_deps in dep_schedule.action.items():
+            if ch_id is None:
+                continue
+            channel = self.topology.channel_id_to_channel[ch_id]
+            for job_id, dep_to_pri in job_to_deps.items():
+                job_idx = self.job_id_to_job_idx[job_id]
+                for dep_id, pri in dep_to_pri.items():
+                    channel.dep_priority[(job_idx, dep_id)] = pri
+
+    # -------------------------------------------------------------- lifecycle
+    def _remove_job_from_cluster(self, job: Job) -> None:
+        job_idx = job.details["job_idx"]
+        if job.job_id in self.job_queue.jobs:
+            self.job_queue.remove(job)
+        self.jobs_running.pop(job_idx, None)
+        for op_id in job.graph.op_ids:
+            key = (job_idx, op_id)
+            worker_id = self.job_op_to_worker.pop(key, None)
+            if worker_id is not None:
+                self.topology.workers[worker_id].unmount(job, op_id)
+        for dep_id in job.graph.edge_ids:
+            key = (job_idx, dep_id)
+            if key in self.job_dep_to_channels:
+                for ch_id in self.job_dep_to_channels[key]:
+                    self.topology.channel_id_to_channel[ch_id].unmount(
+                        job, dep_id)
+                del self.job_dep_to_channels[key]
+        self.job_op_placement.pop(job.job_id, None)
+        self.job_dep_placement.pop(job.job_id, None)
+
+    def _register_completed_job(self, job: Job) -> None:
+        job.register_completed(time_completed=self.stopwatch.time())
+        job_idx = job.details["job_idx"]
+        self.jobs_completed[job_idx] = job
+        self.step_stats["num_jobs_completed"] += 1
+        self.episode_stats["num_jobs_completed"] += 1
+
+        jct = job.details["time_completed"] - job.details["time_arrived"]
+        e = self.episode_stats
+        e["job_completion_time"].append(jct)
+        e["job_completion_time_speedup"].append(
+            job.seq_completion_time / jct if jct > 0 else 0.0)
+        e["job_communication_overhead_time"].append(
+            job.details["communication_overhead_time"])
+        e["job_computation_overhead_time"].append(
+            job.details["computation_overhead_time"])
+        e["jobs_completed_num_nodes"].append(job.graph.n_ops)
+        e["jobs_completed_num_edges"].append(job.graph.n_deps)
+        e["jobs_completed_total_operation_memory_cost"].append(
+            job.immutable["job_total_op_memory_cost"])
+        e["jobs_completed_total_dependency_size"].append(
+            job.immutable["job_total_dep_size"])
+        e["jobs_completed_max_partitions_per_op"].append(
+            job.details.get("max_partitions_per_op", 1))
+        e["jobs_completed_job_sequential_completion_time"].append(
+            job.seq_completion_time)
+        e["jobs_completed_max_acceptable_job_completion_time_frac"].append(
+            job.max_acceptable_jct_frac)
+        e["jobs_completed_max_acceptable_job_completion_time"].append(
+            job.max_acceptable_jct)
+        e["jobs_completed_num_mounted_workers"].append(
+            len(job.details["mounted_workers"]))
+        e["jobs_completed_num_mounted_channels"].append(
+            len(job.details["mounted_channels"]))
+        e["jobs_completed_mean_mounted_worker_utilisation_frac"].append(
+            job.details.get("mean_mounted_worker_utilisation_frac", 0.0))
+        orig = job.original_job
+        e["jobs_completed_original_demand_num_nodes"].append(orig.graph.n_ops)
+        e["jobs_completed_original_demand_num_edges"].append(orig.graph.n_deps)
+        e["jobs_completed_original_demand_total_operation_memory_cost"].append(
+            orig.immutable["job_total_op_memory_cost"])
+        e["jobs_completed_original_demand_total_dependency_size"].append(
+            orig.immutable["job_total_dep_size"])
+
+        self._remove_job_from_cluster(job)
+
+    def _register_blocked_job(self, job: Job) -> None:
+        job_idx = job.details["job_idx"]
+        if job.job_id in self.job_queue.jobs:
+            self.job_queue.remove(job)
+        self.jobs_running.pop(job_idx, None)
+        if job_idx in self.jobs_blocked:
+            return
+        self.jobs_blocked[job_idx] = job
+        self.step_stats["num_jobs_blocked"] += 1
+        self.episode_stats["num_jobs_blocked"] += 1
+        e = self.episode_stats
+        e["jobs_blocked_num_nodes"].append(job.graph.n_ops)
+        e["jobs_blocked_num_edges"].append(job.graph.n_deps)
+        e["jobs_blocked_total_operation_memory_cost"].append(
+            job.immutable["job_total_op_memory_cost"])
+        e["jobs_blocked_total_dependency_size"].append(
+            job.immutable["job_total_dep_size"])
+        e["jobs_blocked_job_sequential_completion_time"].append(
+            job.seq_completion_time)
+        e["jobs_blocked_max_acceptable_job_completion_time_frac"].append(
+            job.max_acceptable_jct_frac)
+        e["jobs_blocked_max_acceptable_job_completion_time"].append(
+            job.max_acceptable_jct)
+        orig = job.original_job
+        e["jobs_blocked_original_demand_num_nodes"].append(orig.graph.n_ops)
+        e["jobs_blocked_original_demand_num_edges"].append(orig.graph.n_deps)
+        e["jobs_blocked_original_demand_total_operation_memory_cost"].append(
+            orig.immutable["job_total_op_memory_cost"])
+        e["jobs_blocked_original_demand_total_dependency_size"].append(
+            orig.immutable["job_total_dep_size"])
+
+    # ------------------------------------------------------------------ stats
+    def _accumulate_tick_stats(self, tick: float) -> None:
+        s = self.step_stats
+        self.mounted_workers, self.mounted_channels = set(), set()
+        utilisations = []
+        for job in self.jobs_running.values():
+            jct = job.details["lookahead_job_completion_time"]
+            frac = tick / jct if jct > 0 else 0.0
+            s["compute_info_processed"] += (
+                job.immutable["job_total_op_memory_cost"] * frac)
+            s["dep_info_processed"] += (
+                job.immutable["job_total_dep_size"] * frac)
+            s["flow_info_processed"] += (
+                job.details.get("job_total_flow_size", 0.0) * frac)
+            s["cluster_info_processed"] += (
+                (job.immutable["job_total_op_memory_cost"]
+                 + job.immutable["job_total_dep_size"]) * frac)
+            orig = job.original_job
+            s["demand_compute_info_processed"] += (
+                orig.immutable["job_total_op_memory_cost"] * frac)
+            s["demand_dep_info_processed"] += (
+                orig.immutable["job_total_dep_size"] * frac)
+            s["demand_total_info_processed"] += (
+                (orig.immutable["job_total_op_memory_cost"]
+                 + orig.immutable["job_total_dep_size"]) * frac)
+            if jct > 0:
+                s["mean_compute_overhead_frac"].append(
+                    job.details["computation_overhead_time"] / jct)
+                s["mean_communication_overhead_frac"].append(
+                    job.details["communication_overhead_time"] / jct)
+            self.mounted_workers.update(job.details["mounted_workers"])
+            self.mounted_channels.update(job.details["mounted_channels"])
+            utilisations.append(
+                job.details.get("mean_mounted_worker_utilisation_frac", 0.0))
+        s["mean_num_jobs_running"].append(len(self.jobs_running))
+        s["mean_num_mounted_workers"].append(len(self.mounted_workers))
+        s["mean_num_mounted_channels"].append(len(self.mounted_channels))
+        if utilisations:
+            s["mean_mounted_worker_utilisation_frac"].append(
+                float(np.mean(utilisations)))
+            s["mean_cluster_worker_utilisation_frac"].append(
+                (len(self.mounted_workers) / self.topology.num_workers)
+                * float(np.mean(utilisations)))
+        else:
+            s["mean_mounted_worker_utilisation_frac"].append(0.0)
+            s["mean_cluster_worker_utilisation_frac"].append(0.0)
+
+    def _finalise_step_stats(self) -> None:
+        s = self.step_stats
+        s["step_end_time"] = self.stopwatch.time()
+        s["step_time"] = s["step_end_time"] - s["step_start_time"]
+        for key in ("mean_num_jobs_running", "mean_num_mounted_workers",
+                    "mean_num_mounted_channels", "mean_compute_overhead_frac",
+                    "mean_communication_overhead_frac",
+                    "mean_mounted_worker_utilisation_frac",
+                    "mean_cluster_worker_utilisation_frac"):
+            s[key] = float(np.mean(s[key])) if len(s[key]) else 0.0
+        for tput, info in (
+                ("mean_compute_throughput", "compute_info_processed"),
+                ("mean_dep_throughput", "dep_info_processed"),
+                ("mean_flow_throughput", "flow_info_processed"),
+                ("mean_cluster_throughput", "cluster_info_processed"),
+                ("mean_demand_compute_throughput", "demand_compute_info_processed"),
+                ("mean_demand_dep_throughput", "demand_dep_info_processed"),
+                ("mean_demand_total_throughput", "demand_total_info_processed")):
+            s[tput] = (s[info] / s["step_time"]
+                       if s[info] != 0 and s["step_time"] != 0 else 0.0)
+        s["job_queue_length"] = len(self.job_queue)
+        for key, val in s.items():
+            self.steps_log[key].append(val)
+        for key in ("compute_info_processed", "dep_info_processed",
+                    "flow_info_processed", "cluster_info_processed",
+                    "demand_compute_info_processed", "demand_dep_info_processed",
+                    "demand_total_info_processed", "mean_compute_overhead_frac",
+                    "mean_communication_overhead_frac", "mean_num_jobs_running",
+                    "mean_num_mounted_workers",
+                    "mean_mounted_worker_utilisation_frac",
+                    "mean_cluster_worker_utilisation_frac"):
+            self.episode_stats[key].append(s[key])
+
+    def _finalise_episode_stats(self) -> None:
+        # block anything still running at simulation end
+        for job in list(self.jobs_running.values()):
+            self._register_blocked_job(job.original_job)
+            self._remove_job_from_cluster(job)
+        e = self.episode_stats
+        e["episode_end_time"] = self.stopwatch.time()
+        e["episode_time"] = e["episode_end_time"] - e["episode_start_time"]
+        e["mean_load_rate"] = (float(np.mean(self.load_rates))
+                               if self.load_rates else 0.0)
+        arrived = e["num_jobs_arrived"]
+        e["blocking_rate"] = e["num_jobs_blocked"] / arrived if arrived else 0.0
+        e["acceptance_rate"] = (e["num_jobs_completed"] / arrived
+                                if arrived else 0.0)
+        for tput, info in (
+                ("mean_compute_throughput", "compute_info_processed"),
+                ("mean_dep_throughput", "dep_info_processed"),
+                ("mean_flow_throughput", "flow_info_processed"),
+                ("mean_cluster_throughput", "cluster_info_processed"),
+                ("mean_demand_compute_throughput", "demand_compute_info_processed"),
+                ("mean_demand_dep_throughput", "demand_dep_info_processed"),
+                ("mean_demand_total_throughput", "demand_total_info_processed")):
+            total = float(np.sum(e[info])) if isinstance(e[info], list) else e[info]
+            e[info] = total
+            e[tput] = (total / e["episode_time"]
+                       if total != 0 and e["episode_time"] != 0 else 0.0)
+        for key in ("mean_compute_overhead_frac",
+                    "mean_communication_overhead_frac", "mean_num_jobs_running",
+                    "mean_num_mounted_workers",
+                    "mean_mounted_worker_utilisation_frac",
+                    "mean_cluster_worker_utilisation_frac"):
+            e[key] = float(np.mean(e[key])) if len(e[key]) else 0.0
+
+    def is_done(self, verbose: bool = False) -> bool:
+        if (self.max_simulation_run_time is not None
+                and self.stopwatch.time() >= self.max_simulation_run_time):
+            return True
+        return (len(self.jobs_generator) == 0 and not self.jobs_running
+                and len(self.job_queue) == 0)
+
+    # ------------------------------------------------------------------- save
+    def _save_logs(self, logs: dict) -> None:
+        out_dir = pathlib.Path(self.path_to_save) / f"reset_{self.reset_counter}"
+        out_dir.mkdir(parents=True, exist_ok=True)
+        for log_name, log in logs.items():
+            with gzip.open(out_dir / f"{log_name}.pkl", "wb") as f:
+                pickle.dump(dict(log), f)
+
+    def save(self) -> None:
+        if self._save_thread is not None:
+            self._save_thread.join()
+        self._save_thread = threading.Thread(
+            target=self._save_logs,
+            args=({"steps_log": self.steps_log,
+                   "episode_stats": self.episode_stats},))
+        self._save_thread.start()
+
+    # static metric catalogues (reference: :1181-1280), used by loaders/loggers
+    @staticmethod
+    def episode_metrics() -> set:
+        return {
+            "episode_start_time", "episode_end_time", "episode_time",
+            "num_jobs_arrived", "num_jobs_completed", "num_jobs_blocked",
+            "compute_info_processed", "dep_info_processed",
+            "flow_info_processed", "cluster_info_processed",
+            "demand_compute_info_processed", "demand_dep_info_processed",
+            "demand_total_info_processed", "mean_compute_throughput",
+            "mean_dep_throughput", "mean_cluster_throughput",
+            "mean_load_rate", "blocking_rate", "acceptance_rate",
+            "mean_flow_throughput", "mean_demand_compute_throughput",
+            "mean_demand_dep_throughput", "mean_demand_total_throughput",
+            "mean_compute_overhead_frac", "mean_communication_overhead_frac",
+            "mean_num_jobs_running", "mean_num_mounted_workers",
+            "mean_mounted_worker_utilisation_frac",
+            "mean_cluster_worker_utilisation_frac",
+            "return", "episode_reward", "run_time", "epoch_counter",
+            "episode_counter", "actor_step_counter",
+        }
+
+    @staticmethod
+    def step_metrics() -> set:
+        return {"mean_num_mounted_workers", "mean_num_mounted_channels"}
+
+    @staticmethod
+    def episode_completion_metrics() -> set:
+        return {
+            "job_completion_time", "job_communication_overhead_time",
+            "job_computation_overhead_time", "jobs_completed_num_nodes",
+            "jobs_completed_num_edges",
+            "jobs_completed_total_operation_memory_cost",
+            "jobs_completed_total_dependency_size",
+            "job_completion_time_speedup",
+            "jobs_completed_max_partitions_per_op",
+            "jobs_completed_job_sequential_completion_time",
+            "jobs_completed_max_acceptable_job_completion_time_frac",
+            "jobs_completed_max_acceptable_job_completion_time",
+            "jobs_completed_num_mounted_workers",
+            "jobs_completed_num_mounted_channels",
+            "jobs_completed_mean_mounted_worker_utilisation_frac",
+            "jobs_completed_original_demand_num_nodes",
+            "jobs_completed_original_demand_num_edges",
+            "jobs_completed_original_demand_total_operation_memory_cost",
+            "jobs_completed_original_demand_total_dependency_size",
+        }
+
+    @staticmethod
+    def episode_blocked_metrics() -> set:
+        return {
+            "jobs_blocked_num_nodes", "jobs_blocked_num_edges",
+            "jobs_blocked_total_operation_memory_cost",
+            "jobs_blocked_total_dependency_size",
+            "jobs_blocked_job_sequential_completion_time",
+            "jobs_blocked_max_acceptable_job_completion_time_frac",
+            "jobs_blocked_max_acceptable_job_completion_time",
+            "jobs_blocked_original_demand_num_nodes",
+            "jobs_blocked_original_demand_num_edges",
+            "jobs_blocked_original_demand_total_operation_memory_cost",
+            "jobs_blocked_original_demand_total_dependency_size",
+        }

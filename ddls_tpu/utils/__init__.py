@@ -2,6 +2,7 @@ from ddls_tpu.utils.common import (
     Stopwatch,
     flatten_lists,
     get_class_from_path,
+    prng_key,
     seed_everything,
     unique_experiment_dir,
     recursive_update,
@@ -11,6 +12,7 @@ __all__ = [
     "Stopwatch",
     "flatten_lists",
     "get_class_from_path",
+    "prng_key",
     "seed_everything",
     "unique_experiment_dir",
     "recursive_update",

@@ -31,21 +31,24 @@ class Stopwatch:
         return self._time
 
 
-def seed_everything(seed: int):
-    """Seed numpy + stdlib random; return a jax PRNG key for functional use.
+def seed_everything(seed: int) -> None:
+    """Seed numpy + stdlib random.
 
     The reference seeds numpy/random/torch-CUDA globally
-    (ddls/utils.py:20-47); in JAX randomness is functional, so we hand back a
-    key to thread through the program instead of mutating backend state.
+    (ddls/utils.py:20-47). JAX randomness is functional; use
+    :func:`prng_key` in RL code to thread a key through instead of mutating
+    backend state. Deliberately does NOT import jax: the simulator is pure
+    host code and must not force accelerator-backend initialisation.
     """
     np.random.seed(seed)
     random.seed(seed)
-    try:
-        import jax
 
-        return jax.random.PRNGKey(seed)
-    except ImportError:  # pragma: no cover - jax is a hard dep in practice
-        return None
+
+def prng_key(seed: int):
+    """A JAX PRNG key for the learner/rollout code paths."""
+    import jax
+
+    return jax.random.PRNGKey(seed)
 
 
 def flatten_lists(nested) -> list:

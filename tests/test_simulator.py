@@ -1,0 +1,243 @@
+"""L3/L4 tests: partition transforms, collective grouping, placers,
+schedulers, and the cluster simulator end to end."""
+import numpy as np
+import pytest
+
+from ddls_tpu.agents import (FirstFitDepPlacer, RampFirstFitOpPlacer,
+                             SRPTDepScheduler, SRPTOpScheduler,
+                             sip_ml_num_partitions)
+from ddls_tpu.agents.partitioners import build_partition_action
+from ddls_tpu.demands.job import Job
+from ddls_tpu.graphs.readers import backward_op_id, graph_from_pipedream_txt
+from ddls_tpu.sim import (Action, OpPartition, RampClusterEnvironment,
+                          partition_graph)
+from ddls_tpu.sim.actions import group_collectives
+
+
+def _chain_profile(tmp_path, n=3):
+    lines = []
+    for i in range(1, n + 1):
+        lines.append(
+            f"node{i} -- Op(id={i}) -- forward_compute_time={float(i):.3f}, "
+            f"backward_compute_time={2 * float(i):.3f}, "
+            f"activation_size={100.0 * i:.1f}, parameter_size={10.0 * i:.1f}")
+    for i in range(1, n):
+        lines.append(f"node{i} -- node{i + 1}")
+    path = tmp_path / "chain.txt"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+# ------------------------------------------------------------------ partition
+def test_partition_graph_semantics(tmp_path):
+    g = graph_from_pipedream_txt(_chain_profile(tmp_path, n=3))
+    # split op 2 (and so its backward op 5) into 2 sub-ops
+    pg = partition_graph(g, {"2": 2})
+
+    # ops: 1,3,4,6 unsplit + 2a,2b,5a,5b
+    assert set(pg.op_ids) == {"1", "3", "4", "6", "2a", "2b", "5a", "5b"}
+    assert pg.compute_cost("2a") == pytest.approx(g.compute_cost("2") / 2)
+    assert pg.memory_cost("5b") == pytest.approx(g.memory_cost("5") / 2)
+
+    # data_split re-bases every edge size on the producer's memory cost
+    assert pg.edge_size("3", "4") == pytest.approx(g.memory_cost("3"))
+
+    # in-edges to sub-ops: size = parent memory / n
+    assert pg.edge_size("1", "2a") == pytest.approx(g.memory_cost("1") / 2)
+    # out-edges from sub-ops: size = child memory / n
+    assert pg.edge_size("2b", "3") == pytest.approx(g.memory_cost("3") / 2)
+
+    # backward sync clique, both directions, sized at sub-op memory
+    assert pg.has_edge("5a", "5b") and pg.has_edge("5b", "5a")
+    assert pg.edge_size("5a", "5b") == pytest.approx(g.memory_cost("5") / 2)
+
+    # dep conservation: chain 3 fwd ops had 5 edges; after split of op 2:
+    # fwd (1,2a),(1,2b),(2a,3),(2b,3); bwd (4,5a),(4,5b),(5a,6),(5b,6);
+    # join (3,4); sync (5a,5b),(5b,5a) -> 11
+    assert pg.n_deps == 11
+
+
+def test_sip_ml_partition_formula():
+    # compute 5.0, quantum 1.0 -> ceil(ceil(5)/2)*2 = 6, capped at 4
+    assert sip_ml_num_partitions(5.0, 1.0, 8) == 6
+    assert sip_ml_num_partitions(5.0, 1.0, 4) == 4
+    assert sip_ml_num_partitions(0.5, 1.0, 8) == 2
+    assert sip_ml_num_partitions(5.0, 100.0, 8) == 2
+
+
+def test_group_collectives_conservation(tmp_path):
+    g = graph_from_pipedream_txt(_chain_profile(tmp_path, n=3))
+    pg = partition_graph(g, {"2": 2})
+    orig = Job(g, 1, 1.0, job_id=1, details={"job_idx": 0})
+    part = Job(pg, 1, 1.0, job_id=1, details={"job_idx": 0},
+               original_job=orig)
+    cand, sync, o2o = group_collectives(orig, part, {"2": 2})
+    total = sum(len(c) for c in cand) + sum(len(s) for s in sync) + len(o2o)
+    assert total == pg.n_deps
+    # exactly one sync group with the two directed sync edges
+    assert len(sync) == 1
+    assert set(sync[0]) == {("5a", "5b"), ("5b", "5a")}
+
+
+# ------------------------------------------------------- cluster end-to-end
+def _make_cluster(**kwargs):
+    return RampClusterEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        **kwargs)
+
+
+def _jobs_config(path, steps=5, frac=1.0):
+    return {
+        "path_to_files": path,
+        "job_interarrival_time_dist": {
+            "_target_": "ddls_tpu.demands.distributions.Fixed", "val": 1e6},
+        "max_acceptable_job_completion_time_frac_dist": {
+            "_target_": "ddls_tpu.demands.distributions.Fixed", "val": frac},
+        "replication_factor": 1,
+        "num_training_steps": steps,
+    }
+
+
+def _heuristic_action(cluster, max_parts):
+    """Partition via SiP-ML-style action then run the full heuristic control
+    plane, as the PAC-ML env does each step."""
+    action_map = {}
+    for job_id, job in cluster.job_queue.jobs.items():
+        action_map[job_id] = build_partition_action(
+            job.graph, min_op_run_time_quantum=0.01,
+            max_partitions_per_op=max_parts)
+    op_partition = OpPartition(action_map, cluster=cluster)
+    op_placement = RampFirstFitOpPlacer().get(op_partition, cluster)
+    op_schedule = SRPTOpScheduler().get(op_partition, op_placement, cluster)
+    dep_placement = FirstFitDepPlacer().get(op_partition, op_placement, cluster)
+    dep_schedule = SRPTDepScheduler().get(op_partition, dep_placement, cluster)
+    return Action(op_partition=op_partition, op_placement=op_placement,
+                  op_schedule=op_schedule, dep_placement=dep_placement,
+                  dep_schedule=dep_schedule)
+
+
+def test_sequential_placement_matches_seq_jct(tmp_path):
+    """Golden invariant: an unpartitioned job placed on one server completes
+    in exactly its sequential completion time (all deps are non-flows)."""
+    path = str(tmp_path)
+    _chain_profile(tmp_path, n=3)
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(path, steps=5), max_simulation_run_time=None,
+                  seed=0)
+    job = list(cluster.job_queue.jobs.values())[0]
+    seq = job.seq_completion_time
+
+    action = _heuristic_action(cluster, max_parts=1)
+    assert len(action.job_ids) == 1
+    cluster.step(action)
+
+    assert len(cluster.jobs_completed) == 1
+    done = list(cluster.jobs_completed.values())[0]
+    assert done.details["lookahead_job_completion_time"] == pytest.approx(seq)
+    assert len(done.details["mounted_workers"]) == 1
+    assert cluster.episode_stats["job_completion_time_speedup"][0] == (
+        pytest.approx(1.0))
+
+
+def test_partitioned_job_speedup(tmp_path):
+    """Partitioning must speed the job up (compute dominates for these
+    profiles) but cost some communication overhead."""
+    path = str(tmp_path)
+    _chain_profile(tmp_path, n=3)
+
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(path, steps=5), seed=0)
+    action = _heuristic_action(cluster, max_parts=4)
+    cluster.step(action)
+    assert len(cluster.jobs_completed) == 1
+    done = list(cluster.jobs_completed.values())[0]
+    jct_part = done.details["lookahead_job_completion_time"]
+    seq = done.seq_completion_time
+    assert jct_part < seq
+    assert done.details["communication_overhead_time"] >= 0
+    assert len(done.details["mounted_workers"]) > 1
+
+
+def test_sla_violation_blocks_job(tmp_path):
+    """A job whose lookahead JCT exceeds its max acceptable JCT blocks."""
+    path = str(tmp_path)
+    _chain_profile(tmp_path, n=3)
+    cluster = _make_cluster()
+    # frac so tight even max partitioning cannot meet it
+    cluster.reset(_jobs_config(path, steps=5, frac=0.001), seed=0)
+    action = _heuristic_action(cluster, max_parts=2)
+    cluster.step(action)
+    assert len(cluster.jobs_blocked) == 1
+    assert len(cluster.jobs_completed) == 0
+    # workers freed again
+    assert all(not w.mounted_job_idx_to_ops
+               for w in cluster.topology.workers.values())
+
+
+def test_unhandled_job_blocks(tmp_path):
+    path = str(tmp_path)
+    _chain_profile(tmp_path, n=3)
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(path), seed=0)
+    cluster.step(Action())  # empty action handles no jobs
+    assert len(cluster.jobs_blocked) == 1
+
+
+def test_lookahead_memoisation(dataset_dir):
+    """Same (model, degree) jobs reuse cached lookahead results."""
+    cluster = _make_cluster()
+    cfg = _jobs_config(dataset_dir, steps=5)
+    cfg["replication_factor"] = 3
+    cfg["job_sampling_mode"] = "remove"  # finite pool so the episode ends
+    cfg["job_interarrival_time_dist"] = {
+        "_target_": "ddls_tpu.demands.distributions.Fixed", "val": 10.0}
+    cluster.reset(cfg, max_simulation_run_time=None, seed=0)
+    steps = 0
+    while not cluster.is_done() and steps < 50:
+        if len(cluster.job_queue):
+            cluster.step(_heuristic_action(cluster, max_parts=2))
+        else:
+            cluster.step(Action())
+        steps += 1
+    assert cluster.is_done()
+    n_outcomes = (cluster.episode_stats["num_jobs_completed"]
+                  + cluster.episode_stats["num_jobs_blocked"])
+    assert n_outcomes == cluster.episode_stats["num_jobs_arrived"] == 9
+    # 3 distinct models x 1 degree -> at most 3+ cache entries, far fewer
+    # than the 9 jobs simulated
+    assert len(cluster.lookahead_cache) <= 6
+
+
+def test_ramp_rule_one_job_per_worker(tmp_path):
+    """Two jobs may never share a worker; the placer must avoid occupied
+    servers."""
+    path = str(tmp_path)
+    _chain_profile(tmp_path, n=3)
+    cluster = _make_cluster()
+    cfg = _jobs_config(path, steps=10000)
+    cfg["replication_factor"] = 2
+    cfg["job_interarrival_time_dist"] = {
+        "_target_": "ddls_tpu.demands.distributions.Fixed", "val": 1.0}
+    cluster.reset(cfg, max_simulation_run_time=None, seed=0)
+    # place job 1 on the cluster (long running)
+    cluster.step(_heuristic_action(cluster, max_parts=2))
+    assert len(cluster.jobs_running) == 1
+    occupied_before = {w for w, worker in cluster.topology.workers.items()
+                      if worker.mounted_job_idx_to_ops}
+    # job 2 arrives; placing it must not reuse occupied workers
+    assert len(cluster.job_queue) == 1
+    cluster.step(_heuristic_action(cluster, max_parts=2))
+    if len(cluster.jobs_running) == 2:
+        jobs = list(cluster.jobs_running.values())
+        w1 = jobs[0].details["mounted_workers"]
+        w2 = jobs[1].details["mounted_workers"]
+        assert not (w1 & w2)
