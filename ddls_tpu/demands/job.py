@@ -39,6 +39,13 @@ def compute_immutable_details(graph: OpGraph, num_training_steps: int) -> dict:
     sizes, depth = arrays["edge_size"], arrays["depth"]
     op_ids, edge_ids = arrays["op_ids"], arrays["edge_ids"]
 
+    if len(compute):
+        throughput = np.divide(memory, compute, out=np.zeros_like(memory),
+                               where=compute > 0)
+        max_op_compute_throughput = float(throughput.max())
+    else:
+        max_op_compute_throughput = 0.0
+
     i_max_compute = int(np.argmax(compute)) if len(compute) else 0
     i_max_memory = int(np.argmax(memory)) if len(memory) else 0
     i_max_depth = int(np.argmax(depth)) if len(depth) else 0
@@ -56,6 +63,9 @@ def compute_immutable_details(graph: OpGraph, num_training_steps: int) -> dict:
         "max_depth": int(depth[i_max_depth]) if len(depth) else 0,
         "max_dep_size_dep": edge_ids[e_max_size] if edge_ids else None,
         "max_dep_size": float(sizes[e_max_size]) if len(sizes) else 0.0,
+        # per-op compute throughput = memory / compute (reference:
+        # job.py:214-222); used to normalise throughput rewards
+        "max_op_compute_throughput": max_op_compute_throughput,
     }
 
 

@@ -186,6 +186,8 @@ class JobsGenerator:
             "job_total_num_deps": [j.graph.n_deps for j in jobs],
             "job_num_training_steps": [j.num_training_steps for j in jobs],
             "job_max_dep_size": [j.immutable["max_dep_size"] for j in jobs],
+            "job_max_op_compute_throughputs": [
+                j.immutable["max_op_compute_throughput"] for j in jobs],
         }
         params = {}
         for key, vals in raw.items():

@@ -1,0 +1,4 @@
+from ddls_tpu.envs.partitioning_env import RampJobPartitioningEnvironment
+from ddls_tpu.envs import baselines, rewards, spaces
+
+__all__ = ["RampJobPartitioningEnvironment", "baselines", "rewards", "spaces"]

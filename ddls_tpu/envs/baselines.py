@@ -1,0 +1,121 @@
+"""Heuristic baseline actors for the partitioning MDP
+(reference: ddls/environments/ramp_job_partitioning/agents/).
+
+All actors implement ``compute_action(obs, job_to_place=None)`` returning an
+int from the env's action set. These are the paper's comparison points:
+Random, NoParallelism (1), MinParallelism (2), MaxParallelism (largest
+valid), SiPML (fixed max), AcceptableJCT (approximately the partition degree
+needed to meet the job's SLA).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def _valid_actions(obs) -> np.ndarray:
+    action_set = np.asarray(obs["action_set"])
+    mask = np.asarray(obs["action_mask"]).astype(bool)
+    return action_set[mask]
+
+
+class BaselineActor:
+    name = "baseline"
+
+    def __init__(self, name: str = None, **kwargs):
+        if name is not None:
+            self.name = name
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        raise NotImplementedError
+
+
+class RandomActor(BaselineActor):
+    name = "random"
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        return int(np.random.choice(_valid_actions(obs)))
+
+
+class NoParallelism(BaselineActor):
+    """Always run sequentially on one worker (action 1 when valid)."""
+
+    name = "no_parallelism"
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        valid = _valid_actions(obs)
+        return 1 if 1 in valid else int(valid[0])
+
+
+class MinParallelism(BaselineActor):
+    """Smallest parallel degree (2) when valid."""
+
+    name = "min_parallelism"
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        valid = _valid_actions(obs)
+        for a in valid:
+            if a >= 2:
+                return int(a)
+        return int(valid[-1])
+
+
+class MaxParallelism(BaselineActor):
+    """Largest valid partition degree."""
+
+    name = "max_parallelism"
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        return int(_valid_actions(obs)[-1])
+
+
+class SiPML(BaselineActor):
+    """Fixed maximum partition degree (the SiP-ML policy: always partition as
+    much as allowed, reference: agents/sip_ml.py)."""
+
+    name = "sip_ml"
+
+    def __init__(self, max_partitions_per_op: int = 16, **kwargs):
+        super().__init__(**kwargs)
+        self.max_partitions_per_op = max_partitions_per_op
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        valid = _valid_actions(obs)
+        candidates = valid[valid <= self.max_partitions_per_op]
+        return int(candidates[-1]) if len(candidates) else int(valid[-1])
+
+
+class AcceptableJCT(BaselineActor):
+    """Partition just enough to (approximately) meet the job's maximum
+    acceptable completion time: target = ceil(sequential / max acceptable),
+    rounded up to the nearest valid action
+    (reference: agents/acceptable_jct.py:21-40). Ignores communication
+    overhead, so it is an approximation the learned policy can beat."""
+
+    name = "acceptable_jct"
+
+    def __init__(self, max_partitions_per_op: int = None, **kwargs):
+        super().__init__(**kwargs)
+        self.max_partitions_per_op = max_partitions_per_op
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        valid = _valid_actions(obs)
+        if len(valid) <= 1 or job_to_place is None:
+            return int(valid[0])
+        target = math.ceil(job_to_place.seq_completion_time
+                           / job_to_place.max_acceptable_jct)
+        action = valid[-1]
+        for a in valid:
+            if a == 0:
+                continue
+            if a >= target:
+                action = a
+                break
+        return int(action)
+
+
+BASELINE_ACTORS = {
+    cls.name: cls for cls in (RandomActor, NoParallelism, MinParallelism,
+                              MaxParallelism, SiPML, AcceptableJCT)
+}

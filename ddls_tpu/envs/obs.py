@@ -1,0 +1,226 @@
+"""Observation encoding for the PAC-ML job-partitioning MDP.
+
+Encodes the queued job's computation graph + cluster state into fixed-size
+padded arrays ready to batch onto TPU (reference:
+ddls/environments/ramp_job_partitioning/observations/
+ramp_job_partitioning_observation.py:15):
+
+* ``node_features`` [max_nodes, 5]: compute cost (normalised by the job's max
+  op cost), is-max-compute flag, memory cost (normalised), is-max-memory
+  flag, depth (normalised by max depth);
+* ``edge_features`` [max_edges, 2]: dep size (normalised by the job's max dep
+  size), is-max-size flag;
+* ``graph_features``: 17 normalised job+cluster scalars (counts, sequential
+  JCT, SLA, totals, op-cost moments, dep-size moments, mounted-worker and
+  running-job fractions) concatenated with the action mask;
+* ``edges_src``/``edges_dst`` [max_edges]: integer endpoints (insertion
+  order), zero-padded; ``node_split``/``edge_split``: true counts.
+
+``max_edges`` is the fully connected bound ``max_nodes*(max_nodes-1)/2``
+(reference: :52). Action-mask validity per the reference (:80-131): action a
+(= max partitions per op; 0 = do not place) is valid iff it is 1 or even, at
+most max_partitions_per_op, at most the number of free workers, and (a > 1)
+some symmetric block shape of a servers exists in the topology.
+
+One deliberate fix vs the reference: its is-max-compute flag compares an op
+id against a per-device dict and is constantly False
+(ramp_job_partitioning_observation.py:533); here the flag is real.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ddls_tpu.agents.block_search import (block_shapes_for, enumerate_block,
+                                          factor_pairs)
+from ddls_tpu.envs import spaces
+
+NODE_FEATURE_DIM = 5
+EDGE_FEATURE_DIM = 2
+GRAPH_FEATURE_DIM = 17
+
+
+def action_is_valid(action: int, env) -> bool:
+    if action == 0:
+        return True
+    if action != 1 and action % 2 != 0:
+        return False
+    if action > env.max_partitions_per_op:
+        return False
+    free_workers = (env.cluster.topology.num_workers
+                    - len(env.cluster.mounted_workers))
+    if action > free_workers:
+        return False
+    if action == 1:
+        return True
+    ramp_shape = env.cluster.topology.shape
+    shapes = block_shapes_for(factor_pairs(action), ramp_shape)
+    for shape in shapes:
+        if enumerate_block(shape, ramp_shape, (0, 0, 0)):
+            return True
+    return False
+
+
+class RampJobPartitioningObservation:
+    def __init__(self,
+                 max_partitions_per_op: int,
+                 pad_obs_kwargs: Optional[dict] = None,
+                 machine_epsilon: float = 1e-7):
+        self.max_partitions_per_op = max_partitions_per_op
+        self.pad_obs_kwargs = pad_obs_kwargs or {}
+        self.machine_epsilon = machine_epsilon
+        self.max_nodes = int(self.pad_obs_kwargs.get("max_nodes", 0))
+        self.max_edges = (self.max_nodes * (self.max_nodes - 1)) // 2
+        self.observation_space: Optional[spaces.Dict] = None
+
+    def reset(self, env) -> None:
+        obs = self.extract(env, done=False)
+        n_actions = self.max_partitions_per_op + 1
+        self.observation_space = spaces.Dict({
+            "action_set": spaces.Box(0, self.max_partitions_per_op,
+                                     (n_actions,), np.int32),
+            "action_mask": spaces.Box(0, 1, (n_actions,), np.int32),
+            "node_features": spaces.Box(
+                0.0, 1.0, obs["node_features"].shape, np.float32),
+            "edge_features": spaces.Box(
+                0.0, 1.0, obs["edge_features"].shape, np.float32),
+            "graph_features": spaces.Box(
+                0.0, 1.0, obs["graph_features"].shape, np.float32),
+            "edges_src": spaces.Box(0, self.max_nodes - 1,
+                                    obs["edges_src"].shape, np.int32),
+            "edges_dst": spaces.Box(0, self.max_nodes - 1,
+                                    obs["edges_dst"].shape, np.int32),
+            "node_split": spaces.Box(0, self.max_nodes, (1,), np.int32),
+            "edge_split": spaces.Box(0, self.max_edges, (1,), np.int32),
+        })
+
+    # ------------------------------------------------------------------ encode
+    def extract(self, env, done: bool) -> Dict[str, np.ndarray]:
+        job = list(env.cluster.job_queue.jobs.values())[0]
+        return self.encode(job, env)
+
+    def get_action_set_and_mask(self, env):
+        action_set = np.arange(self.max_partitions_per_op + 1, dtype=np.int32)
+        mask = np.array([action_is_valid(a, env) for a in action_set],
+                        dtype=np.int32)
+        return action_set, mask
+
+    def encode(self, job, env) -> Dict[str, np.ndarray]:
+        graph = job.graph
+        n, m = graph.n_ops, graph.n_deps
+        if self.max_nodes and n > self.max_nodes:
+            raise ValueError(
+                f"job has {n} ops but pad_obs max_nodes={self.max_nodes}; "
+                "increase max_nodes or use smaller graphs")
+        if self.max_nodes and m > self.max_edges:
+            raise ValueError(
+                f"job has {m} deps but max_edges={self.max_edges}")
+
+        arrays = graph.finalize()
+        node_feats = self._node_features(job, arrays)
+        edge_feats = self._edge_features(job, arrays)
+        graph_feats = self._graph_features(job, env)
+        action_set, action_mask = self.get_action_set_and_mask(env)
+        graph_feats = np.concatenate(
+            [graph_feats, action_mask.astype(np.float32)])
+
+        srcs = arrays["edge_src"].astype(np.int32)
+        dsts = arrays["edge_dst"].astype(np.int32)
+
+        max_n = self.max_nodes or n
+        max_e = self.max_edges or m
+        obs = {
+            "action_set": action_set,
+            "action_mask": action_mask,
+            "node_features": _pad2(node_feats, max_n),
+            "edge_features": _pad2(edge_feats, max_e),
+            "graph_features": graph_feats.astype(np.float32),
+            "edges_src": _pad1(srcs, max_e),
+            "edges_dst": _pad1(dsts, max_e),
+            "node_split": np.array([n], dtype=np.int32),
+            "edge_split": np.array([m], dtype=np.int32),
+        }
+        for key, val in obs.items():
+            if not np.all(np.isfinite(val)):
+                raise ValueError(f"observation field {key} contains NaN/inf")
+        return obs
+
+    def _node_features(self, job, arrays) -> np.ndarray:
+        compute, memory, depth = (arrays["compute"], arrays["memory"],
+                                  arrays["depth"])
+        max_c = max(job.immutable["max_compute_cost"], 1e-30)
+        max_m = max(job.immutable["max_memory_cost"], 1e-30)
+        max_d = max(job.immutable["max_depth"], 1)
+        feats = np.stack([
+            compute / max_c,
+            (compute == job.immutable["max_compute_cost"]).astype(np.float64),
+            memory / max_m,
+            (memory == job.immutable["max_memory_cost"]).astype(np.float64),
+            depth / max_d,
+        ], axis=1)
+        return np.clip(feats, 0.0, 1.0)
+
+    def _edge_features(self, job, arrays) -> np.ndarray:
+        sizes = arrays["edge_size"]
+        max_s = max(job.immutable["max_dep_size"], 1e-30)
+        feats = np.stack([
+            sizes / max_s,
+            (sizes == job.immutable["max_dep_size"]).astype(np.float64),
+        ], axis=1)
+        return np.clip(feats, 0.0, 1.0)
+
+    def _graph_features(self, job, env) -> np.ndarray:
+        params = env.cluster.jobs_generator.jobs_params
+        arrays = job.graph.finalize()
+
+        def norm(val, key) -> float:
+            lo, hi = params[f"min_{key}"], params[f"max_{key}"]
+            if hi - lo == 0:
+                return 1.0
+            return float((val - lo) / (hi - lo))
+
+        max_c = max(job.immutable["max_compute_cost"], 1e-30)
+        max_m = max(job.immutable["max_memory_cost"], 1e-30)
+        max_s = max(job.immutable["max_dep_size"], 1e-30)
+        compute_norm = arrays["compute"] / max_c
+        memory_norm = arrays["memory"] / max_m
+        sizes = arrays["edge_size"]
+
+        topo = env.cluster.topology
+        feats = [
+            norm(job.graph.n_ops, "job_total_num_ops"),
+            norm(job.graph.n_deps, "job_total_num_deps"),
+            norm(job.seq_completion_time, "job_sequential_completion_times"),
+            norm(job.max_acceptable_jct,
+                 "max_acceptable_job_completion_times"),
+            norm(job.max_acceptable_jct_frac,
+                 "max_acceptable_job_completion_time_fracs"),
+            job.max_acceptable_jct_frac,
+            norm(job.immutable["job_total_op_memory_cost"],
+                 "job_total_op_memory_costs"),
+            norm(job.immutable["job_total_dep_size"], "job_total_dep_sizes"),
+            norm(job.num_training_steps, "job_num_training_steps"),
+            float(np.mean(compute_norm)),
+            float(np.median(compute_norm)),
+            float(np.mean(memory_norm)),
+            float(np.median(memory_norm)),
+            float(np.mean(sizes) / max_s) if len(sizes) else 0.0,
+            float(np.median(sizes) / max_s) if len(sizes) else 0.0,
+            len(env.cluster.mounted_workers) / topo.num_workers,
+            len(env.cluster.jobs_running) / topo.num_workers,
+        ]
+        assert len(feats) == GRAPH_FEATURE_DIM
+        return np.clip(np.array(feats, dtype=np.float32), 0.0, 1.0)
+
+
+def _pad2(x: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((n, x.shape[1]), dtype=np.float32)
+    out[:len(x)] = x
+    return out
+
+
+def _pad1(x: np.ndarray, n: int) -> np.ndarray:
+    out = np.zeros((n,), dtype=x.dtype)
+    out[:len(x)] = x
+    return out

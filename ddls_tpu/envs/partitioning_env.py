@@ -1,0 +1,212 @@
+"""The PAC-ML job-partitioning environment.
+
+MDP framing (reference: ddls/environments/ramp_job_partitioning/
+ramp_job_partitioning_environment.py:42): each decision point is a job at the
+head of the queue; the discrete action a in {0..max_partitions_per_op} is the
+*maximum partition degree* for that job (0 = do not place). The env converts
+the action to per-op partition counts with the SiP-ML quantum formula, runs
+the heuristic control plane (first-fit op placer -> SRPT op scheduler ->
+first-fit dep placer -> SRPT dep scheduler), steps the cluster, computes the
+reward, then auto-steps the cluster with empty actions until another job is
+queued (so every agent step sees exactly one job to decide on).
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional, Union
+
+import numpy as np
+
+from ddls_tpu.agents.partitioners import sip_ml_num_partitions
+from ddls_tpu.agents.placers import (FirstFitDepPlacer, RampFirstFitOpPlacer,
+                                     RandomOpPlacer)
+from ddls_tpu.agents.schedulers import SRPTDepScheduler, SRPTOpScheduler
+from ddls_tpu.envs import spaces
+from ddls_tpu.envs.obs import RampJobPartitioningObservation
+from ddls_tpu.envs.rewards import make_reward_function
+from ddls_tpu.sim.actions import Action, OpPartition
+from ddls_tpu.sim.cluster import RampClusterEnvironment
+
+OP_PLACERS = {
+    "ramp_first_fit_op_placer": RampFirstFitOpPlacer,
+    "random_op_placer": RandomOpPlacer,
+}
+OP_SCHEDULERS = {"srpt_op_scheduler": SRPTOpScheduler}
+DEP_PLACERS = {"first_fit_dep_placer": FirstFitDepPlacer}
+DEP_SCHEDULERS = {"srpt_dep_scheduler": SRPTDepScheduler}
+
+
+class RampJobPartitioningEnvironment:
+    def __init__(self,
+                 topology_config: dict,
+                 node_config: dict,
+                 jobs_config: dict,
+                 max_partitions_per_op: Optional[int] = None,
+                 min_op_run_time_quantum: float = 0.01,
+                 op_placer: str = "ramp_first_fit_op_placer",
+                 op_placer_kwargs: Optional[dict] = None,
+                 op_scheduler: str = "srpt_op_scheduler",
+                 op_scheduler_kwargs: Optional[dict] = None,
+                 dep_placer: str = "first_fit_dep_placer",
+                 dep_placer_kwargs: Optional[dict] = None,
+                 dep_scheduler: str = "srpt_dep_scheduler",
+                 dep_scheduler_kwargs: Optional[dict] = None,
+                 observation_function: str = "ramp_job_partitioning_observation",
+                 pad_obs_kwargs: Optional[dict] = None,
+                 information_function: str = "default",
+                 reward_function: str = "lookahead_job_completion_time",
+                 reward_function_kwargs: Optional[dict] = None,
+                 max_simulation_run_time: Optional[float] = None,
+                 job_queue_capacity: int = 10,
+                 suppress_warnings: bool = True,
+                 name: str = "ramp_job_partitioning",
+                 path_to_save: Optional[str] = None,
+                 save_cluster_data: bool = False,
+                 save_freq: int = 1,
+                 use_sqlite_database: bool = False,
+                 apply_action_mask: bool = True,
+                 **kwargs):
+        self.topology_config = topology_config
+        self.node_config = node_config
+        self.jobs_config = jobs_config
+        self.max_simulation_run_time = (
+            float("inf") if max_simulation_run_time is None
+            else float(max_simulation_run_time))
+        self.job_queue_capacity = job_queue_capacity
+        self.apply_action_mask = apply_action_mask
+        self.name = name
+
+        self.cluster = RampClusterEnvironment(
+            topology_config=topology_config,
+            node_config=node_config,
+            name=name,
+            path_to_save=path_to_save if save_cluster_data else None,
+            save_freq=save_freq,
+            use_sqlite_database=use_sqlite_database,
+            suppress_warnings=suppress_warnings)
+
+        self.max_partitions_per_op = (
+            max_partitions_per_op if max_partitions_per_op is not None
+            else self.cluster.topology.num_workers)
+        self.min_op_run_time_quantum = min_op_run_time_quantum
+
+        if observation_function != "ramp_job_partitioning_observation":
+            raise ValueError(
+                f"unrecognised observation_function {observation_function!r}")
+        self.observation_function = RampJobPartitioningObservation(
+            self.max_partitions_per_op, pad_obs_kwargs=pad_obs_kwargs)
+
+        self.action_set = list(range(self.max_partitions_per_op + 1))
+        self.action_space = spaces.Discrete(len(self.action_set))
+        self.observation_space: Optional[spaces.Dict] = None
+
+        self.reward_function = make_reward_function(
+            reward_function, reward_function_kwargs)
+
+        self.op_placer = OP_PLACERS[op_placer](**(op_placer_kwargs or {}))
+        self.op_scheduler = OP_SCHEDULERS[op_scheduler](
+            **(op_scheduler_kwargs or {}))
+        self.dep_placer = DEP_PLACERS[dep_placer](**(dep_placer_kwargs or {}))
+        self.dep_scheduler = DEP_SCHEDULERS[dep_scheduler](
+            **(dep_scheduler_kwargs or {}))
+
+    # ------------------------------------------------------------------- api
+    def reset(self, seed: Optional[int] = None, verbose: bool = False):
+        self.step_counter = 1
+        self.cluster.reset(jobs_config=self.jobs_config,
+                           max_simulation_run_time=self.max_simulation_run_time,
+                           job_queue_capacity=self.job_queue_capacity,
+                           seed=seed)
+        self.observation_function.reset(self)
+        self.observation_space = self.observation_function.observation_space
+        self.reward_function.reset(env=self)
+        self.obs = self._get_observation()
+        return self.obs
+
+    def _is_done(self) -> bool:
+        return self.cluster.is_done()
+
+    def _get_observation(self):
+        return self.observation_function.extract(env=self, done=self._is_done())
+
+    def _step_cluster(self, action: Action) -> None:
+        self.cluster.step(action)
+        self.cluster_step_stats[self.cluster.step_counter] = (
+            self.cluster.step_stats)
+
+    def _partition_action_for(self, job, max_partitions: int):
+        """Action int -> per-op partition counts via the SiP-ML quantum
+        formula (reference: :331-343)."""
+        per_op = {}
+        for f_op in job.graph.forward_op_ids():
+            n = sip_ml_num_partitions(job.graph.compute_cost(f_op),
+                                      self.min_op_run_time_quantum,
+                                      max_partitions)
+            per_op[str(int(f_op))] = n
+            b_op = job.graph.counterpart(f_op)
+            if b_op is not None:
+                per_op[str(int(b_op))] = n
+        return per_op
+
+    def step(self, action: int, verbose: bool = False):
+        self.cluster_step_stats = {}
+
+        action = int(action)
+        if action not in self.action_set:
+            raise ValueError(
+                f"action {action} not in action set {self.action_set}")
+        if not self.obs["action_mask"][action]:
+            if self.apply_action_mask:
+                raise ValueError(
+                    f"action {action} is invalid under the current action "
+                    f"mask {self.obs['action_mask']}; set "
+                    "apply_action_mask=False to silently fall back to 0")
+            action = 0
+
+        if action != 0:
+            job_id, job = next(iter(self.cluster.job_queue.jobs.items()))
+            partition_map = {job_id: self._partition_action_for(job, action)}
+            self.op_partition = OpPartition(partition_map,
+                                            cluster=self.cluster)
+        else:
+            self.op_partition = OpPartition({}, cluster=self.cluster)
+
+        self.op_placement = self.op_placer.get(
+            op_partition=self.op_partition, cluster=self.cluster)
+        self.op_schedule = self.op_scheduler.get(
+            op_partition=self.op_partition, op_placement=self.op_placement,
+            cluster=self.cluster)
+        self.dep_placement = self.dep_placer.get(
+            op_partition=self.op_partition, op_placement=self.op_placement,
+            cluster=self.cluster)
+        self.dep_schedule = self.dep_scheduler.get(
+            op_partition=self.op_partition, dep_placement=self.dep_placement,
+            cluster=self.cluster)
+        self.action = Action(op_partition=self.op_partition,
+                             op_placement=self.op_placement,
+                             op_schedule=self.op_schedule,
+                             dep_placement=self.dep_placement,
+                             dep_schedule=self.dep_schedule)
+
+        self.last_job_arrived_job_idx = self.cluster.last_job_arrived_job_idx
+        self._step_cluster(self.action)
+
+        # jobs the action handled that also survived SLA lookahead
+        self.placed_job_idxs = set(self.action.job_idxs)
+        for job_idx in list(self.placed_job_idxs):
+            if job_idx in self.cluster.jobs_blocked:
+                self.placed_job_idxs.discard(job_idx)
+
+        self.reward = self.reward_function.extract(env=self,
+                                                   done=self._is_done())
+
+        # auto-step until another job queues or the episode ends
+        while len(self.cluster.job_queue) == 0 and not self.cluster.is_done():
+            self._step_cluster(Action())
+
+        self.done = self._is_done()
+        if not self.done:
+            self.obs = self._get_observation()
+        self.info = {}
+        self.step_counter += 1
+        return self.obs, self.reward, self.done, self.info

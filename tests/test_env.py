@@ -1,0 +1,140 @@
+"""L5 tests: PAC-ML env, observation encoding, rewards, baseline actors."""
+import numpy as np
+import pytest
+
+from ddls_tpu.envs import RampJobPartitioningEnvironment
+from ddls_tpu.envs.baselines import (AcceptableJCT, MaxParallelism,
+                                     NoParallelism, RandomActor)
+from ddls_tpu.envs.obs import GRAPH_FEATURE_DIM
+
+
+def _make_env(dataset_dir, reward="job_acceptance", reward_kwargs=None,
+              steps=50, interarrival=1000.0, replication=3,
+              sampling="remove", max_parts=8):
+    return RampJobPartitioningEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": interarrival},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.1, "max_val": 1.0, "decimals": 2},
+            "replication_factor": replication,
+            "job_sampling_mode": sampling,
+            "num_training_steps": steps},
+        max_partitions_per_op=max_parts,
+        min_op_run_time_quantum=0.01,
+        reward_function=reward,
+        reward_function_kwargs=reward_kwargs or {"fail_reward": -1,
+                                                 "success_reward": 1},
+        max_simulation_run_time=1e5,
+        pad_obs_kwargs={"max_nodes": 150},
+        apply_action_mask=True)
+
+
+def test_obs_shapes_and_mask(dataset_dir):
+    env = _make_env(dataset_dir)
+    obs = env.reset(seed=0)
+    max_e = (150 * 149) // 2
+    assert obs["node_features"].shape == (150, 5)
+    assert obs["edge_features"].shape == (max_e, 2)
+    assert obs["edges_src"].shape == (max_e,)
+    assert obs["graph_features"].shape == (GRAPH_FEATURE_DIM + 9,)
+    assert obs["action_mask"][0] == 1  # 0 always valid
+    # odd actions > 1 invalid
+    for a in (3, 5, 7):
+        assert obs["action_mask"][a] == 0
+    assert obs["node_features"].min() >= 0
+    assert obs["node_features"].max() <= 1
+    assert np.all(np.isfinite(obs["graph_features"]))
+    # node_split matches the queued job's op count
+    job = list(env.cluster.job_queue.jobs.values())[0]
+    assert obs["node_split"][0] == job.graph.n_ops
+    assert obs["edge_split"][0] == job.graph.n_deps
+
+
+def test_full_episode_with_acceptable_jct(dataset_dir):
+    env = _make_env(dataset_dir)
+    obs = env.reset(seed=0)
+    actor = AcceptableJCT()
+    total_reward, steps = 0.0, 0
+    done = False
+    while not done and steps < 100:
+        job = list(env.cluster.job_queue.jobs.values())[0]
+        action = actor.compute_action(obs, job_to_place=job)
+        obs, reward, done, info = env.step(action)
+        total_reward += reward
+        steps += 1
+    assert done
+    e = env.cluster.episode_stats
+    assert e["num_jobs_arrived"] == (e["num_jobs_completed"]
+                                     + e["num_jobs_blocked"])
+    # job_acceptance reward: +1/-1 per decision
+    assert total_reward == (e["num_jobs_completed"] - e["num_jobs_blocked"])
+
+
+def test_invalid_action_raises_or_falls_back(dataset_dir):
+    env = _make_env(dataset_dir)
+    obs = env.reset(seed=0)
+    with pytest.raises(ValueError):
+        env.step(3)  # odd -> invalid under mask
+    env.apply_action_mask = False
+    obs, reward, done, info = env.step(3)  # falls back to 0 (don't place)
+    assert reward == -1  # job blocked
+
+
+def test_action_zero_blocks_job(dataset_dir):
+    env = _make_env(dataset_dir)
+    env.reset(seed=0)
+    n_blocked_before = env.cluster.episode_stats["num_jobs_blocked"]
+    obs, reward, done, info = env.step(0)
+    assert env.cluster.episode_stats["num_jobs_blocked"] == n_blocked_before + 1
+    assert reward == -1
+
+
+def test_baseline_ordering(dataset_dir):
+    """Sanity: AcceptableJCT should accept at least as many jobs as
+    NoParallelism under tight SLAs (the paper's qualitative ordering)."""
+    results = {}
+    for actor_cls in (NoParallelism, AcceptableJCT, MaxParallelism):
+        env = _make_env(dataset_dir, replication=4)
+        obs = env.reset(seed=42)
+        actor = actor_cls()
+        done, steps = False, 0
+        while not done and steps < 150:
+            job = list(env.cluster.job_queue.jobs.values())[0]
+            action = actor.compute_action(obs, job_to_place=job)
+            obs, _, done, _ = env.step(action)
+            steps += 1
+        results[actor_cls.name] = (
+            env.cluster.episode_stats["acceptance_rate"])
+    assert results["acceptable_jct"] >= results["no_parallelism"]
+
+
+def test_lookahead_jct_reward(dataset_dir):
+    env = _make_env(dataset_dir, reward="lookahead_job_completion_time",
+                    reward_kwargs={
+                        "fail_reward": "job_sequential_completion_time",
+                        "fail_reward_factor": 10, "sign": -1,
+                        "normaliser": "job_sequential_completion_time_times_fail_reward_factor"})
+    obs = env.reset(seed=0)
+    # blocked job (action 0): reward = -(seq*10)/(seq*10) = -1
+    obs, reward, done, info = env.step(0)
+    assert reward == pytest.approx(-1.0)
+    # placed job: reward = -(jct/(seq*10)) in (-1, 0)
+    if not done:
+        valid = obs["action_set"][obs["action_mask"].astype(bool)]
+        obs, reward, done, info = env.step(int(valid[-1]))
+        if reward != pytest.approx(-1.0):
+            assert -1.0 < reward < 0.0
