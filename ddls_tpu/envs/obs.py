@@ -32,8 +32,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ddls_tpu.agents.block_search import (block_shapes_for, enumerate_block,
-                                          factor_pairs)
+from ddls_tpu.agents.block_search import block_shapes_for, factor_pairs
 from ddls_tpu.envs import spaces
 
 NODE_FEATURE_DIM = 5
@@ -55,11 +54,9 @@ def action_is_valid(action: int, env) -> bool:
     if action == 1:
         return True
     ramp_shape = env.cluster.topology.shape
-    shapes = block_shapes_for(factor_pairs(action), ramp_shape)
-    for shape in shapes:
-        if enumerate_block(shape, ramp_shape, (0, 0, 0)):
-            return True
-    return False
+    # valid iff some symmetric block shape of `action` servers fits the
+    # topology; block_shapes_for already filters to fitting shapes
+    return bool(block_shapes_for(factor_pairs(action), ramp_shape))
 
 
 class RampJobPartitioningObservation:
@@ -75,24 +72,27 @@ class RampJobPartitioningObservation:
         self.observation_space: Optional[spaces.Dict] = None
 
     def reset(self, env) -> None:
-        obs = self.extract(env, done=False)
         n_actions = self.max_partitions_per_op + 1
+        if self.max_nodes:
+            max_n, max_e = self.max_nodes, self.max_edges
+        else:
+            # unpadded mode: shapes follow the queued job's true size
+            job = list(env.cluster.job_queue.jobs.values())[0]
+            max_n, max_e = job.graph.n_ops, job.graph.n_deps
         self.observation_space = spaces.Dict({
             "action_set": spaces.Box(0, self.max_partitions_per_op,
                                      (n_actions,), np.int32),
             "action_mask": spaces.Box(0, 1, (n_actions,), np.int32),
             "node_features": spaces.Box(
-                0.0, 1.0, obs["node_features"].shape, np.float32),
+                0.0, 1.0, (max_n, NODE_FEATURE_DIM), np.float32),
             "edge_features": spaces.Box(
-                0.0, 1.0, obs["edge_features"].shape, np.float32),
+                0.0, 1.0, (max_e, EDGE_FEATURE_DIM), np.float32),
             "graph_features": spaces.Box(
-                0.0, 1.0, obs["graph_features"].shape, np.float32),
-            "edges_src": spaces.Box(0, self.max_nodes - 1,
-                                    obs["edges_src"].shape, np.int32),
-            "edges_dst": spaces.Box(0, self.max_nodes - 1,
-                                    obs["edges_dst"].shape, np.int32),
-            "node_split": spaces.Box(0, self.max_nodes, (1,), np.int32),
-            "edge_split": spaces.Box(0, self.max_edges, (1,), np.int32),
+                0.0, 1.0, (GRAPH_FEATURE_DIM + n_actions,), np.float32),
+            "edges_src": spaces.Box(0, max_n - 1, (max_e,), np.int32),
+            "edges_dst": spaces.Box(0, max_n - 1, (max_e,), np.int32),
+            "node_split": spaces.Box(0, max_n, (1,), np.int32),
+            "edge_split": spaces.Box(0, max_e, (1,), np.int32),
         })
 
     # ------------------------------------------------------------------ encode

@@ -17,7 +17,7 @@ from typing import Optional, Union
 
 import numpy as np
 
-from ddls_tpu.agents.partitioners import sip_ml_num_partitions
+from ddls_tpu.agents.partitioners import build_partition_action
 from ddls_tpu.agents.placers import (FirstFitDepPlacer, RampFirstFitOpPlacer,
                                      RandomOpPlacer)
 from ddls_tpu.agents.schedulers import SRPTDepScheduler, SRPTOpScheduler
@@ -137,16 +137,8 @@ class RampJobPartitioningEnvironment:
     def _partition_action_for(self, job, max_partitions: int):
         """Action int -> per-op partition counts via the SiP-ML quantum
         formula (reference: :331-343)."""
-        per_op = {}
-        for f_op in job.graph.forward_op_ids():
-            n = sip_ml_num_partitions(job.graph.compute_cost(f_op),
-                                      self.min_op_run_time_quantum,
+        return build_partition_action(job.graph, self.min_op_run_time_quantum,
                                       max_partitions)
-            per_op[str(int(f_op))] = n
-            b_op = job.graph.counterpart(f_op)
-            if b_op is not None:
-                per_op[str(int(b_op))] = n
-        return per_op
 
     def step(self, action: int, verbose: bool = False):
         self.cluster_step_stats = {}
@@ -197,12 +189,19 @@ class RampJobPartitioningEnvironment:
             if job_idx in self.cluster.jobs_blocked:
                 self.placed_job_idxs.discard(job_idx)
 
-        self.reward = self.reward_function.extract(env=self,
-                                                   done=self._is_done())
-
-        # auto-step until another job queues or the episode ends
+        # auto-step until another job queues or the episode ends, THEN
+        # extract the reward so throughput rewards see the cluster steps in
+        # which the placed job actually ran. (Deliberate fix vs the
+        # reference, which resets cluster_step_stats at the start of step()
+        # and extracts before auto-stepping — :311,391 — so its throughput
+        # rewards only ever see the single placement step. Acceptance/JCT
+        # rewards are unaffected: they read lookahead values fixed at
+        # placement, and no job can be placed or blocked during auto-steps.)
         while len(self.cluster.job_queue) == 0 and not self.cluster.is_done():
             self._step_cluster(Action())
+
+        self.reward = self.reward_function.extract(env=self,
+                                                   done=self._is_done())
 
         self.done = self._is_done()
         if not self.done:

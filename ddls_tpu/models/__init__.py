@@ -1,0 +1,14 @@
+"""TPU-native policy networks (flax).
+
+The reference's L6 is a DGL + RLlib ``TorchModelV2`` GNN policy
+(ddls/ml_models/). Here the same architecture is expressed as flax modules
+over fixed-shape padded arrays: message passing is ``segment_sum`` scatter
+(ddls_tpu.ops) instead of DGL's C++ kernels, and the whole
+forward is vmapped over the batch — no per-sample Python graph construction
+(the reference's known hot-loop sink, ddls/ml_models/policies/
+gnn_policy.py:226-253 loops over the batch building DGL graphs).
+"""
+from ddls_tpu.models.gnn import GNN, MeanPoolLayer
+from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
+
+__all__ = ["MeanPoolLayer", "GNN", "GNNPolicy", "batched_policy_apply"]

@@ -1,0 +1,105 @@
+"""GNN policy: per-node embeddings -> pooled graph embedding -> masked
+action logits + value.
+
+Parity with the reference RLlib policy (ddls/ml_models/policies/
+gnn_policy.py:53): node embeddings from the GNN are masked-mean-pooled; the
+graph features (which already include the action mask, obs.py) are embedded
+by a LayerNorm MLP; both embeddings are concatenated and read out by an MLP
+into action logits and, via a separate branch, a state-value estimate
+(RLlib's FullyConnectedNetwork with vf_share_layers=False). Invalid actions
+get log(0)-masked logits so they can never be sampled
+(gnn_policy.py:265-271).
+
+The forward is written for a single observation; ``batched_policy_apply``
+vmaps it over the leading batch axis — this replaces the reference's Python
+loop building one DGL graph per batch element (gnn_policy.py:226-253).
+"""
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ddls_tpu.models.gnn import GNN, FeatureModule, get_activation
+from ddls_tpu.ops.segment import masked_mean
+
+
+class MLPHead(nn.Module):
+    """Plain Dense stack used for the logit and value readouts (the
+    reference uses RLlib's FullyConnectedNetwork here)."""
+
+    hiddens: Sequence[int]
+    out_features: int
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        act = get_activation(self.activation)
+        for h in self.hiddens:
+            x = act(nn.Dense(h)(x))
+        return nn.Dense(self.out_features)(x)
+
+
+class GNNPolicy(nn.Module):
+    """Actor-critic over one padded-graph observation.
+
+    Returns (logits [n_actions], value []). Defaults follow the tuned
+    reference config (scripts/ramp_job_partitioning_configs/model/gnn.yaml).
+    """
+
+    n_actions: int
+    out_features_msg: int = 32
+    out_features_hidden: int = 64
+    out_features_node: int = 16
+    out_features_graph: int = 8
+    num_rounds: int = 2
+    module_depth: int = 1
+    activation: str = "relu"
+    fcnet_hiddens: Sequence[int] = (256, 256)
+    fcnet_activation: str = "relu"
+    apply_action_mask: bool = True
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jnp.ndarray]
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        node_feats = obs["node_features"]
+        edge_feats = obs["edge_features"]
+        edges_src = obs["edges_src"]
+        edges_dst = obs["edges_dst"]
+        n_nodes = obs["node_split"][0]
+        n_edges = obs["edge_split"][0]
+        node_mask = (jnp.arange(node_feats.shape[0]) < n_nodes)
+        edge_mask = (jnp.arange(edge_feats.shape[0]) < n_edges)
+
+        gnn = GNN(self.out_features_msg, self.out_features_hidden,
+                  self.out_features_node, self.num_rounds, self.module_depth,
+                  self.activation, name="gnn")
+        node_emb = gnn(node_feats, edge_feats, edges_src, edges_dst,
+                       node_mask, edge_mask)
+        pooled = masked_mean(node_emb, node_mask)
+
+        graph_emb = FeatureModule(self.out_features_graph, self.module_depth,
+                                  self.activation, name="graph_module")(
+            obs["graph_features"])
+        final_emb = jnp.concatenate([pooled, graph_emb], axis=-1)
+
+        logits = MLPHead(self.fcnet_hiddens, self.n_actions,
+                         self.fcnet_activation, name="logit_head")(final_emb)
+        value = MLPHead(self.fcnet_hiddens, 1, self.fcnet_activation,
+                        name="value_head")(final_emb)[0]
+
+        if self.apply_action_mask:
+            mask = obs["action_mask"].astype(jnp.float32)
+            inf_mask = jnp.maximum(jnp.log(mask),
+                                   jnp.finfo(jnp.float32).min)
+            logits = logits + inf_mask
+        return logits, value
+
+
+def batched_policy_apply(model: GNNPolicy, params,
+                         obs: Dict[str, jnp.ndarray]):
+    """Apply the policy over a batch: dict of [B, ...] arrays ->
+    (logits [B, n_actions], values [B])."""
+    return jax.vmap(lambda o: model.apply(params, o))(obs)

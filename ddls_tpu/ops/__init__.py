@@ -1,0 +1,13 @@
+"""XLA-native graph primitives: masked segment reductions used by the GNN.
+
+The reference delegates message passing to DGL's C++ scatter/gather kernels
+(ddls/ml_models/models/mean_pool.py). On TPU the idiomatic equivalent is
+``jax.ops.segment_sum`` over padded edge lists — XLA lowers these to fused
+scatter-adds that run on-chip, and the fixed shapes make the whole policy
+batchable with ``vmap`` (no per-sample graph construction, the reference's
+known perf sink, ddls/ml_models/policies/gnn_policy.py:226-253).
+"""
+from ddls_tpu.ops.segment import (masked_mean, masked_segment_mean,
+                                  masked_segment_sum)
+
+__all__ = ["masked_segment_sum", "masked_segment_mean", "masked_mean"]

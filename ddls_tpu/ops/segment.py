@@ -1,0 +1,58 @@
+"""Masked segment reductions over padded edge lists.
+
+All functions take fixed-shape (padded) arrays plus boolean masks so they are
+safe under ``jit``/``vmap``/``pjit`` — padding rows contribute nothing, and
+output shapes are static. Padding edges should point at segment 0; the mask
+is what removes their contribution, so the index values of padded entries
+never matter.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def masked_segment_sum(data: jnp.ndarray,
+                       segment_ids: jnp.ndarray,
+                       mask: jnp.ndarray,
+                       num_segments: int) -> jnp.ndarray:
+    """Sum ``data[e]`` into ``out[segment_ids[e]]`` for unmasked edges.
+
+    Args:
+      data: [E, F] per-edge values.
+      segment_ids: [E] int destination per edge (padding may be 0).
+      mask: [E] bool, True for real edges.
+      num_segments: static number of output segments (padded node count).
+
+    Returns: [num_segments, F].
+    """
+    data = jnp.where(mask[:, None], data, 0.0)
+    return jax.ops.segment_sum(data, segment_ids, num_segments=num_segments)
+
+
+def masked_segment_mean(data: jnp.ndarray,
+                        segment_ids: jnp.ndarray,
+                        mask: jnp.ndarray,
+                        num_segments: int,
+                        extra: jnp.ndarray = None) -> jnp.ndarray:
+    """Mean of incoming edge values per segment, optionally averaged together
+    with one ``extra`` [num_segments, F] value per segment (the GNN's
+    self-message: mean over {self} ∪ mailbox).
+
+    Segments with no incoming edges (and no extra) return 0.
+    """
+    totals = masked_segment_sum(data, segment_ids, mask, num_segments)
+    counts = jax.ops.segment_sum(mask.astype(data.dtype), segment_ids,
+                                 num_segments=num_segments)
+    if extra is not None:
+        totals = totals + extra
+        counts = counts + 1.0
+    return totals / jnp.maximum(counts, 1.0)[:, None]
+
+
+def masked_mean(data: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Mean over the unmasked rows of ``data`` [N, F]; 0 if all masked."""
+    weights = mask.astype(data.dtype)
+    total = jnp.sum(data * weights[:, None], axis=0)
+    count = jnp.maximum(jnp.sum(weights), 1.0)
+    return total / count
