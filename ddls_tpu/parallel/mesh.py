@@ -1,0 +1,88 @@
+"""Device-mesh helpers: the distributed backend of the framework.
+
+The reference scales RL training with Ray/RLlib worker processes and keeps
+its learner on one GPU (SURVEY.md §5.8); the TPU-native replacement is a
+single SPMD program over a ``jax.sharding.Mesh``. Data (trajectory batches)
+is sharded over the ``dp`` axis with ``NamedSharding``; parameters are
+replicated; XLA then emits the gradient all-reduce (``psum`` over ICI) from
+the sharding annotations alone — there is no NCCL/MPI code to write.
+
+On a real pod slice, call ``jax.distributed.initialize()`` first (one process
+per host) and these helpers operate on the global device set; on a laptop or
+in tests, ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` provides a
+virtual N-device mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("dp",),
+              devices=None) -> Mesh:
+    """Build a mesh over the first ``n_devices`` devices.
+
+    With one axis name the mesh is a 1-D data-parallel mesh; more axis names
+    split the device count into factors, largest-last (e.g. ``("dp", "tp")``
+    with 8 devices -> dp=2, tp=4).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devices)} "
+                "are available")
+        devices = devices[:n_devices]
+    n = len(devices)
+    shape = []
+    remaining = n
+    for _ in axis_names[:-1]:
+        f = _largest_factor_leq(remaining, int(np.sqrt(remaining)))
+        shape.append(f)
+        remaining //= f
+    shape.append(remaining)
+    mesh_devices = np.asarray(devices).reshape(shape)
+    return Mesh(mesh_devices, axis_names)
+
+
+def _largest_factor_leq(n: int, k: int) -> int:
+    for f in range(max(k, 1), 0, -1):
+        if n % f == 0:
+            return f
+    return 1
+
+
+def batch_sharding(mesh: Mesh, batch_axis: int = 0,
+                   axis_name: str = "dp") -> NamedSharding:
+    """Sharding that splits ``batch_axis`` over ``axis_name``."""
+    spec = [None] * batch_axis + [axis_name]
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, tree, batch_axis: int = 0,
+                axis_name: str = "dp"):
+    """Place every leaf of ``tree`` on the mesh, sharded over its batch axis.
+
+    Leaves whose batch dimension is not divisible by the mesh axis size are
+    rejected (callers pad rollout batches to a multiple of the dp size).
+    """
+    sharding = batch_sharding(mesh, batch_axis, axis_name)
+    axis_size = mesh.shape[axis_name]
+
+    def put(x):
+        x = np.asarray(x) if not isinstance(x, jax.Array) else x
+        if x.ndim <= batch_axis or x.shape[batch_axis] % axis_size:
+            raise ValueError(
+                f"leaf shape {getattr(x, 'shape', None)} not shardable over "
+                f"{axis_size} devices on axis {batch_axis}")
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(put, tree)

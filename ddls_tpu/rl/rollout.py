@@ -1,0 +1,146 @@
+"""Vectorised rollout collection.
+
+Replaces RLlib's Ray rollout workers (SURVEY.md §3.1): instead of N worker
+processes each owning an environment and a policy copy, one host process
+steps B environment instances, stacks their padded observations into [B, ...]
+arrays, and samples all B actions in a single jitted device call
+(``PPOLearner.sample_actions``). The simulator itself runs per-step on the
+host (its per-job heuristic placer is sequential/combinatorial — SURVEY.md
+§7.4.2); the device sees only fixed-shape batched tensors.
+
+Environments auto-reset on episode end; completed-episode returns/lengths and
+the cluster's episode stats are harvested for logging, mirroring what RLlib's
+callbacks collect (ddls/environments/ramp_cluster/utils.py:25-73).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+OBS_KEYS = ("node_features", "edge_features", "graph_features",
+            "edges_src", "edges_dst", "node_split", "edge_split",
+            "action_mask")
+
+
+def stack_obs(obs_list: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    return {k: np.stack([np.asarray(o[k]) for o in obs_list])
+            for k in OBS_KEYS}
+
+
+class VectorEnv:
+    """B independent environment instances with auto-reset."""
+
+    def __init__(self, env_fns: List[Callable[[], Any]],
+                 seeds: Optional[List[int]] = None):
+        self.envs = [fn() for fn in env_fns]
+        self.num_envs = len(self.envs)
+        self.seeds = seeds or list(range(self.num_envs))
+        self.episode_returns = np.zeros(self.num_envs)
+        self.episode_lengths = np.zeros(self.num_envs, dtype=np.int64)
+        self.completed_episodes: List[Dict[str, Any]] = []
+
+    def reset(self) -> List[Dict[str, np.ndarray]]:
+        self.obs = [env.reset(seed=self.seeds[i])
+                    for i, env in enumerate(self.envs)]
+        self.episode_returns[:] = 0.0
+        self.episode_lengths[:] = 0
+        return self.obs
+
+    def step(self, actions: np.ndarray):
+        rewards = np.zeros(self.num_envs, dtype=np.float32)
+        dones = np.zeros(self.num_envs, dtype=bool)
+        for i, env in enumerate(self.envs):
+            obs, reward, done, _ = env.step(int(actions[i]))
+            rewards[i] = reward
+            dones[i] = done
+            self.episode_returns[i] += reward
+            self.episode_lengths[i] += 1
+            if done:
+                self._harvest_episode(i, env)
+                # fresh seed per episode so workload sampling differs
+                self.seeds[i] += self.num_envs
+                obs = env.reset(seed=self.seeds[i])
+                self.episode_returns[i] = 0.0
+                self.episode_lengths[i] = 0
+            self.obs[i] = obs
+        return self.obs, rewards, dones
+
+    def _harvest_episode(self, i: int, env) -> None:
+        record = {"env_index": i,
+                  "episode_return": float(self.episode_returns[i]),
+                  "episode_length": int(self.episode_lengths[i])}
+        cluster = getattr(env, "cluster", None)
+        if cluster is not None and getattr(cluster, "episode_stats", None):
+            stats = cluster.episode_stats
+            for key in ("num_jobs_arrived", "num_jobs_completed",
+                        "num_jobs_blocked", "blocking_rate",
+                        "acceptance_rate"):
+                if key in stats:
+                    record[key] = stats[key]
+            for key in ("job_completion_time",
+                        "job_completion_time_speedup"):
+                vals = stats.get(key)
+                if vals:
+                    record[f"mean_{key}"] = float(np.mean(vals))
+        self.completed_episodes.append(record)
+
+    def drain_completed_episodes(self) -> List[Dict[str, Any]]:
+        out, self.completed_episodes = self.completed_episodes, []
+        return out
+
+
+class RolloutCollector:
+    """Collects [T, B] trajectory batches for the PPO learner."""
+
+    def __init__(self, vec_env: VectorEnv, learner, rollout_length: int):
+        self.vec_env = vec_env
+        self.learner = learner
+        self.rollout_length = rollout_length
+        self._needs_reset = True
+
+    def collect(self, params, rng) -> Dict[str, Any]:
+        """Run rollout_length steps in every env; returns a trajectory dict
+        of [T, B, ...] host arrays plus bootstrap values [B]."""
+        T, B = self.rollout_length, self.vec_env.num_envs
+        if self._needs_reset:
+            self.vec_env.reset()
+            self._needs_reset = False
+
+        obs_buf: List[Dict[str, np.ndarray]] = []
+        act_buf = np.zeros((T, B), dtype=np.int32)
+        logp_buf = np.zeros((T, B), dtype=np.float32)
+        val_buf = np.zeros((T, B), dtype=np.float32)
+        rew_buf = np.zeros((T, B), dtype=np.float32)
+        done_buf = np.zeros((T, B), dtype=bool)
+
+        for t in range(T):
+            batched = stack_obs(self.vec_env.obs)
+            rng, step_rng = jax.random.split(rng)
+            actions, logp, values = self.learner.sample_actions(
+                params, batched, step_rng)
+            actions = np.asarray(actions)
+            obs_buf.append(batched)
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(values)
+            _, rewards, dones = self.vec_env.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+
+        final = stack_obs(self.vec_env.obs)
+        rng, val_rng = jax.random.split(rng)
+        _, _, last_values = self.learner.sample_actions(params, final,
+                                                        val_rng)
+
+        traj_obs = {k: np.stack([o[k] for o in obs_buf])
+                    for k in OBS_KEYS}
+        return {
+            "traj": {"obs": traj_obs, "actions": act_buf, "logp": logp_buf,
+                     "values": val_buf, "rewards": rew_buf,
+                     "dones": done_buf},
+            "last_values": np.asarray(last_values),
+            "episodes": self.vec_env.drain_completed_episodes(),
+            "env_steps": T * B,
+        }

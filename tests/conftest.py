@@ -5,12 +5,22 @@ sharding/pjit/psum code paths are exercised without TPU hardware (the standard
 JAX substitute for a fake multi-chip backend; see SURVEY.md §4).
 """
 import os
+import re
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Site hooks may have imported (and pinned) jax onto an accelerator backend
+# before this conftest runs; jax.config.update re-pins the platform as long
+# as no backend has been initialised yet.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, (
+    f"expected the virtual 8-device CPU mesh, got {jax.devices()}")
 
 import numpy as np
 import pytest
