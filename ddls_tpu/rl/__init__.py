@@ -1,5 +1,5 @@
 from ddls_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
-from ddls_tpu.rl.rollout import RolloutCollector, VectorEnv
+from ddls_tpu.rl.rollout import ParallelVectorEnv, RolloutCollector, VectorEnv
 
-__all__ = ["PPOConfig", "PPOLearner", "compute_gae", "RolloutCollector",
-           "VectorEnv"]
+__all__ = ["PPOConfig", "PPOLearner", "compute_gae", "ParallelVectorEnv",
+           "RolloutCollector", "VectorEnv"]

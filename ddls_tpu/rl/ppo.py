@@ -252,7 +252,9 @@ class PPOLearner:
                 jax.random.split(erng, D))
 
             def shuffle(x):
-                x = jax.vmap(lambda row, p: row[p])(x, perms)
+                # drop the remainder of each shard so the minibatch grid is
+                # exact (num_mb * mb_loc <= n_loc)
+                x = jax.vmap(lambda row, p: row[p[:num_mb * mb_loc]])(x, perms)
                 x = x.reshape((D, num_mb, mb_loc) + x.shape[2:])
                 x = jnp.swapaxes(x, 0, 1)  # [num_mb, D, mb_loc, ...]
                 return x.reshape((num_mb, D * mb_loc) + x.shape[3:])

@@ -14,7 +14,8 @@ callbacks collect (ddls/environments/ramp_cluster/utils.py:25-73).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional
+import multiprocessing as mp
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -27,6 +28,29 @@ OBS_KEYS = ("node_features", "edge_features", "graph_features",
 def stack_obs(obs_list: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
     return {k: np.stack([np.asarray(o[k]) for o in obs_list])
             for k in OBS_KEYS}
+
+
+def harvest_episode_record(env, env_index: int, episode_return: float,
+                           episode_length: int) -> Dict[str, Any]:
+    """Episode summary + the cluster's episode stats, mirroring what RLlib's
+    callbacks collect (ddls/environments/ramp_cluster/utils.py:25-73)."""
+    record = {"env_index": env_index,
+              "episode_return": float(episode_return),
+              "episode_length": int(episode_length)}
+    cluster = getattr(env, "cluster", None)
+    if cluster is not None and getattr(cluster, "episode_stats", None):
+        stats = cluster.episode_stats
+        for key in ("num_jobs_arrived", "num_jobs_completed",
+                    "num_jobs_blocked", "blocking_rate",
+                    "acceptance_rate"):
+            if key in stats:
+                record[key] = stats[key]
+        for key in ("job_completion_time",
+                    "job_completion_time_speedup"):
+            vals = stats.get(key)
+            if vals:
+                record[f"mean_{key}"] = float(np.mean(vals))
+    return record
 
 
 class VectorEnv:
@@ -68,27 +92,136 @@ class VectorEnv:
         return self.obs, rewards, dones
 
     def _harvest_episode(self, i: int, env) -> None:
-        record = {"env_index": i,
-                  "episode_return": float(self.episode_returns[i]),
-                  "episode_length": int(self.episode_lengths[i])}
-        cluster = getattr(env, "cluster", None)
-        if cluster is not None and getattr(cluster, "episode_stats", None):
-            stats = cluster.episode_stats
-            for key in ("num_jobs_arrived", "num_jobs_completed",
-                        "num_jobs_blocked", "blocking_rate",
-                        "acceptance_rate"):
-                if key in stats:
-                    record[key] = stats[key]
-            for key in ("job_completion_time",
-                        "job_completion_time_speedup"):
-                vals = stats.get(key)
-                if vals:
-                    record[f"mean_{key}"] = float(np.mean(vals))
-        self.completed_episodes.append(record)
+        self.completed_episodes.append(harvest_episode_record(
+            env, i, self.episode_returns[i], self.episode_lengths[i]))
 
     def drain_completed_episodes(self) -> List[Dict[str, Any]]:
         out, self.completed_episodes = self.completed_episodes, []
         return out
+
+    def close(self) -> None:
+        pass
+
+
+def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
+                         env_index: int, seed: int, seed_stride: int) -> None:
+    """Subprocess body: owns one env, steps it on command, auto-resets.
+
+    ``env_builder`` is a picklable callable (class or factory) receiving
+    ``**env_kwargs`` — the process-parallel replacement for RLlib's Ray
+    rollout workers, each of which builds its own env from the env_config
+    (SURVEY.md §3.1 process-boundary note).
+    """
+    try:
+        env = env_builder(**env_kwargs)
+        episode_return, episode_length = 0.0, 0
+        while True:
+            cmd, payload = conn.recv()
+            if cmd == "reset":
+                seed = payload if payload is not None else seed
+                obs = env.reset(seed=seed)
+                episode_return, episode_length = 0.0, 0
+                conn.send(("obs", obs))
+            elif cmd == "step":
+                obs, reward, done, _ = env.step(int(payload))
+                episode_return += reward
+                episode_length += 1
+                record = None
+                if done:
+                    record = harvest_episode_record(
+                        env, env_index, episode_return, episode_length)
+                    seed += seed_stride
+                    obs = env.reset(seed=seed)
+                    episode_return, episode_length = 0.0, 0
+                conn.send(("step", (obs, float(reward), bool(done), record)))
+            elif cmd == "close":
+                conn.send(("closed", None))
+                return
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:  # surface worker crashes to the parent
+        import traceback
+        conn.send(("error", f"{e}\n{traceback.format_exc()}"))
+
+
+class ParallelVectorEnv:
+    """B environment instances stepped in B subprocesses.
+
+    Same interface as ``VectorEnv``. Env construction arguments must be
+    picklable (builder callable + kwargs dict), since workers are spawned
+    fresh — which also keeps the TPU runtime out of the children (only the
+    parent process touches jax).
+    """
+
+    def __init__(self, env_builder: Callable[..., Any],
+                 env_kwargs: Dict[str, Any], num_envs: int,
+                 seeds: Optional[List[int]] = None,
+                 start_method: str = "spawn"):
+        self.num_envs = num_envs
+        self.seeds = seeds or list(range(num_envs))
+        ctx = mp.get_context(start_method)
+        self._conns = []
+        self._procs = []
+        for i in range(num_envs):
+            parent, child = ctx.Pipe()
+            proc = ctx.Process(
+                target=_parallel_env_worker,
+                args=(child, env_builder, env_kwargs, i, self.seeds[i],
+                      num_envs),
+                daemon=True)
+            proc.start()
+            child.close()
+            self._conns.append(parent)
+            self._procs.append(proc)
+        self.completed_episodes: List[Dict[str, Any]] = []
+        self.obs: List[Dict[str, np.ndarray]] = []
+        self._first_reset = True
+
+    def _recv(self, conn) -> Tuple[str, Any]:
+        kind, payload = conn.recv()
+        if kind == "error":
+            self.close()
+            raise RuntimeError(f"env worker failed:\n{payload}")
+        return kind, payload
+
+    def reset(self) -> List[Dict[str, np.ndarray]]:
+        # seeds live worker-side (advanced on every auto-reset); only the
+        # first reset pins them, later resets continue each worker's sequence
+        payload = self.seeds if self._first_reset else [None] * self.num_envs
+        self._first_reset = False
+        for conn, seed in zip(self._conns, payload):
+            conn.send(("reset", seed))
+        self.obs = [self._recv(conn)[1] for conn in self._conns]
+        return self.obs
+
+    def step(self, actions: np.ndarray):
+        for conn, action in zip(self._conns, actions):
+            conn.send(("step", int(action)))
+        rewards = np.zeros(self.num_envs, dtype=np.float32)
+        dones = np.zeros(self.num_envs, dtype=bool)
+        for i, conn in enumerate(self._conns):
+            _, (obs, reward, done, record) = self._recv(conn)
+            self.obs[i] = obs
+            rewards[i] = reward
+            dones[i] = done
+            if record is not None:
+                self.completed_episodes.append(record)
+        return self.obs, rewards, dones
+
+    def drain_completed_episodes(self) -> List[Dict[str, Any]]:
+        out, self.completed_episodes = self.completed_episodes, []
+        return out
+
+    def close(self) -> None:
+        for conn in self._conns:
+            try:
+                conn.send(("close", None))
+            except (BrokenPipeError, OSError):
+                pass
+        for proc in self._procs:
+            proc.join(timeout=5)
+            if proc.is_alive():
+                proc.terminate()
 
 
 class RolloutCollector:

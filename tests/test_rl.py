@@ -9,7 +9,8 @@ import pytest
 
 from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
 from ddls_tpu.parallel import make_mesh
-from ddls_tpu.rl import PPOConfig, PPOLearner, RolloutCollector, VectorEnv
+from ddls_tpu.rl import (ParallelVectorEnv, PPOConfig, PPOLearner,
+                         RolloutCollector, VectorEnv)
 from ddls_tpu.rl.ppo import compute_gae
 
 
@@ -191,6 +192,25 @@ class _ToyEnv:
         return self._obs(), 1.0, done, {}
 
 
+def test_train_step_nondivisible_minibatch(model_and_params):
+    """Remainder samples are dropped per shard when the per-device sample
+    count is not a multiple of the per-device minibatch size."""
+    model, params = model_and_params
+    mesh = make_mesh(8)
+    learner = PPOLearner(
+        lambda p, o: batched_policy_apply(model, p, o),
+        PPOConfig(num_sgd_iter=2, sgd_minibatch_size=16), mesh)
+    state = learner.init_state(params)
+    rng = np.random.RandomState(9)
+    traj = _fake_traj(rng, T=5, B=8)  # n=40, n_loc=5, mb_loc=2 -> 2 mbs
+    last_values = rng.randn(8).astype(np.float32)
+    straj, slv = learner.shard_traj(traj, last_values)
+    new_state, metrics = learner.train_step(state, straj, slv,
+                                            jax.random.PRNGKey(10))
+    assert np.isfinite(float(metrics["total_loss"]))
+    assert int(new_state.step) == 2 * 2
+
+
 def test_vector_env_autoreset_and_collect(model_and_params):
     model, params = model_and_params
     mesh = make_mesh(1)
@@ -208,3 +228,29 @@ def test_vector_env_autoreset_and_collect(model_and_params):
     # dones marked at episode boundaries (t = 2 and 5, 0-indexed)
     assert out["traj"]["dones"][2].all() and out["traj"]["dones"][5].all()
     assert not out["traj"]["dones"][0].any()
+
+
+def test_parallel_vector_env_matches_serial():
+    """ParallelVectorEnv must behave like VectorEnv: same rewards/dones,
+    auto-reset, episode harvesting, and seed continuity across reset()."""
+    par = ParallelVectorEnv(_ToyEnv, {}, 4, start_method="spawn")
+    ser = VectorEnv([_ToyEnv for _ in range(4)])
+    par.reset()
+    ser.reset()
+    for t in range(7):
+        actions = np.zeros(4, dtype=np.int32)
+        obs_p, rew_p, done_p = par.step(actions)
+        obs_s, rew_s, done_s = ser.step(actions)
+        np.testing.assert_array_equal(rew_p, rew_s)
+        np.testing.assert_array_equal(done_p, done_s)
+        for op, os_ in zip(obs_p, obs_s):
+            np.testing.assert_allclose(op["node_features"],
+                                       os_["node_features"])
+    eps_p = par.drain_completed_episodes()
+    eps_s = ser.drain_completed_episodes()
+    assert len(eps_p) == len(eps_s) == 8
+    assert all(ep["episode_return"] == 3.0 for ep in eps_p)
+    # a second reset must not raise and must keep stepping fine
+    par.reset()
+    par.step(np.zeros(4, dtype=np.int32))
+    par.close()
