@@ -1,0 +1,187 @@
+"""YAML experiment-config system (Hydra-compatible subset).
+
+The reference composes experiments with Hydra + OmegaConf (SURVEY.md §5.6):
+a root config with a ``defaults:`` list of config groups
+(``- env_config: env_dev`` loads ``env_config/env_dev.yaml`` under the key
+``env_config``), ``_target_`` class-path instantiation, and dotted-path CLI
+overrides. Hydra is not available in this environment, so this module
+implements the same composition semantics on plain PyYAML:
+
+* ``load_config(config_path, config_name, overrides)`` — load + merge the
+  ``defaults`` groups under their group names, then apply the root config's
+  own keys, then CLI overrides (``a.b.c=value`` for values,
+  ``group=name`` to re-select a config group).
+* ``instantiate(cfg)`` — recursive ``_target_`` instantiation
+  (hydra.utils.instantiate equivalent). Reference (``ddls.*``) class paths
+  in configs are mapped to their ddls_tpu equivalents by
+  ``get_class_from_path``, so the reference's own config trees load
+  unchanged.
+* ``save_config(cfg, path)`` — snapshot the composed config to the run dir
+  (reference: train_rllib_from_config.py:96).
+"""
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import re
+
+import yaml
+
+from ddls_tpu.utils.common import get_class_from_path, recursive_update
+
+
+class _ConfigLoader(yaml.SafeLoader):
+    """SafeLoader that also accepts scientific notation without a signed
+    exponent (``1.6e12``), which YAML 1.1 would otherwise read as a string
+    (OmegaConf handles this for the reference's configs)."""
+
+
+_ConfigLoader.add_implicit_resolver(
+    "tag:yaml.org,2002:float",
+    re.compile(r"""^(?:
+        [-+]?(?:[0-9][0-9_]*)\.[0-9_]*(?:[eE][-+]?[0-9]+)?
+       |[-+]?(?:[0-9][0-9_]*)(?:[eE][-+]?[0-9]+)
+       |\.[0-9_]+(?:[eE][-+]?[0-9]+)?
+       |[-+]?\.(?:inf|Inf|INF)
+       |\.(?:nan|NaN|NAN))$""", re.X),
+    list("-+0123456789."))
+
+
+def _yaml_load(stream):
+    return yaml.load(stream, Loader=_ConfigLoader)
+
+
+def _load_yaml(path: str) -> dict:
+    with open(path) as f:
+        data = _yaml_load(f)
+    return data or {}
+
+
+def _find_config_file(config_path: str, name: str) -> str:
+    if not name.endswith((".yaml", ".yml")):
+        name = name + ".yaml"
+    full = os.path.join(config_path, name)
+    if not os.path.exists(full):
+        raise FileNotFoundError(f"config file not found: {full}")
+    return full
+
+
+def _parse_override_value(raw: str) -> Any:
+    try:
+        return _yaml_load(raw)
+    except yaml.YAMLError:
+        return raw
+
+
+def set_by_dotted_path(cfg: dict, dotted: str, value: Any) -> None:
+    keys = dotted.split(".")
+    node = cfg
+    for key in keys[:-1]:
+        if not isinstance(node.get(key), dict):
+            node[key] = {}
+        node = node[key]
+    node[keys[-1]] = value
+
+
+def get_by_dotted_path(cfg: dict, dotted: str, default: Any = None) -> Any:
+    node = cfg
+    for key in dotted.split("."):
+        if not isinstance(node, dict) or key not in node:
+            return default
+        node = node[key]
+    return node
+
+
+def load_config(config_path: str, config_name: str,
+                overrides: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+    """Compose a config exactly as the reference's Hydra setup does.
+
+    Group entries in the root config's ``defaults:`` list are loaded from
+    ``{config_path}/{group}/{name}.yaml`` and placed under ``cfg[group]``;
+    the root config's own keys are merged on top; overrides apply last.
+    An override ``group=name`` re-selects a config group if
+    ``{config_path}/{group}/`` exists, otherwise it sets a plain value.
+    """
+    overrides = list(overrides or [])
+    root = _load_yaml(_find_config_file(config_path, config_name))
+    defaults = root.pop("defaults", [])
+
+    # group re-selection overrides must apply before group loading
+    group_selext: Dict[str, str] = {}
+    value_overrides: List[str] = []
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override must be key=value, got: {ov}")
+        key, _, raw = ov.partition("=")
+        if ("." not in key
+                and os.path.isdir(os.path.join(config_path, key))):
+            group_selext[key] = str(raw)
+        else:
+            value_overrides.append(ov)
+
+    cfg: Dict[str, Any] = {}
+    for entry in defaults:
+        if isinstance(entry, str):  # e.g. "_self_"
+            continue
+        (group, name), = entry.items()
+        name = group_selext.pop(group, name)
+        group_cfg = _load_yaml(
+            _find_config_file(os.path.join(config_path, group), str(name)))
+        cfg.setdefault(group, {})
+        recursive_update(cfg[group], group_cfg)
+    for group, name in group_selext.items():  # overrides of unlisted groups
+        group_cfg = _load_yaml(
+            _find_config_file(os.path.join(config_path, group), str(name)))
+        cfg.setdefault(group, {})
+        recursive_update(cfg[group], group_cfg)
+
+    recursive_update(cfg, root)
+
+    for ov in value_overrides:
+        key, _, raw = ov.partition("=")
+        set_by_dotted_path(cfg, key, _parse_override_value(raw))
+    return cfg
+
+
+def instantiate(node: Any, **extra_kwargs) -> Any:
+    """Recursively build objects from ``_target_`` dicts.
+
+    Non-``_target_`` dicts/lists are traversed; leaves pass through.
+    ``extra_kwargs`` are merged into the top-level target's kwargs only
+    (matching hydra.utils.instantiate(cfg, **kwargs)).
+    """
+    if isinstance(node, dict) and "_target_" in node:
+        node = dict(node)
+        target = node.pop("_target_")
+        kwargs = {k: instantiate(v) for k, v in node.items()}
+        kwargs.update(extra_kwargs)
+        cls = get_class_from_path(target)
+        return cls(**kwargs)
+    if isinstance(node, dict):
+        return {k: instantiate(v) for k, v in node.items()}
+    if isinstance(node, list):
+        return [instantiate(v) for v in node]
+    return node
+
+
+def save_config(cfg: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(_to_plain(cfg), f, default_flow_style=False,
+                       sort_keys=False)
+
+
+def _to_plain(node: Any) -> Any:
+    if isinstance(node, dict):
+        return {k: _to_plain(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return [_to_plain(v) for v in node]
+    if hasattr(node, "item") and getattr(node, "ndim", None) == 0:
+        return node.item()
+    return node
+
+
+def deep_copy_config(cfg: dict) -> dict:
+    return copy.deepcopy(cfg)

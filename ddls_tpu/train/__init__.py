@@ -1,0 +1,12 @@
+from ddls_tpu.train.checkpointer import (Checkpointer, restore_train_state,
+                                         save_train_state)
+from ddls_tpu.train.launcher import Launcher
+from ddls_tpu.train.logger import Logger, SqliteDict
+from ddls_tpu.train.loops import (EnvLoop, EpochLoop, EvalLoop, RLEpochLoop,
+                                  RLEvalLoop, build_policy_from_model_config,
+                                  ppo_config_from_rllib)
+
+__all__ = ["Checkpointer", "restore_train_state", "save_train_state",
+           "Launcher", "Logger", "SqliteDict", "EnvLoop", "EpochLoop",
+           "EvalLoop", "RLEpochLoop", "RLEvalLoop",
+           "build_policy_from_model_config", "ppo_config_from_rllib"]

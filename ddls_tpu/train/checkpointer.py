@@ -1,0 +1,51 @@
+"""Agent checkpointing via orbax.
+
+The reference delegates to RLlib ``trainer.save`` through a thin
+``Checkpointer`` (ddls/checkpointers/checkpointer.py:3,
+ddls/loops/rllib_epoch_loop.py:251); here the epoch loop exposes
+``save_agent_checkpoint(path)`` (orbax PyTree checkpoint of the learner
+``TrainState``) and this class owns the directory layout + cadence.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional
+
+
+class Checkpointer:
+    def __init__(self, path_to_save: str,
+                 epoch_checkpoint_freq: Optional[int] = 1, **kwargs):
+        self.checkpoints_dir = Path(path_to_save) / "checkpoints"
+        self.checkpoints_dir.mkdir(parents=True, exist_ok=True)
+        self.epoch_checkpoint_freq = epoch_checkpoint_freq
+
+    def should_checkpoint(self, epoch_counter: int) -> bool:
+        return (self.epoch_checkpoint_freq is not None
+                and epoch_counter % self.epoch_checkpoint_freq == 0)
+
+    def write(self, epoch_loop, epoch_counter: int) -> str:
+        path = self.checkpoints_dir / f"checkpoint_{epoch_counter:06d}"
+        epoch_loop.save_agent_checkpoint(str(path))
+        return str(path)
+
+
+def save_train_state(state, path: str) -> None:
+    """Orbax-save a learner TrainState (params/opt_state/counters)."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(str(Path(path).absolute()), state, force=True)
+
+
+def restore_train_state(path: str, target=None):
+    """Restore a TrainState saved by :func:`save_train_state`.
+
+    ``target`` (a template state with matching structure) restores typed
+    arrays; without it, orbax returns the raw pytree.
+    """
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    if target is not None:
+        return ckptr.restore(str(Path(path).absolute()), item=target)
+    return ckptr.restore(str(Path(path).absolute()))

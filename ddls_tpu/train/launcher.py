@@ -1,0 +1,133 @@
+"""Experiment driver (reference: ddls/launchers/launcher.py:17).
+
+Runs epoch-loop iterations until a stop condition is met (num_epochs /
+num_episodes / num_actor_steps), accumulates results, triggers the logger at
+its configured frequencies and the checkpointer at its cadence, and keeps
+the epoch loop's best-checkpoint tracking fed.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+
+class Launcher:
+    def __init__(self,
+                 epoch_loop,
+                 num_epochs: Optional[int] = None,
+                 num_episodes: Optional[int] = None,
+                 num_actor_steps: Optional[int] = None,
+                 num_eval_episodes: Optional[int] = None,
+                 eval_freq: Optional[int] = None,
+                 epoch_batch_size: int = 1,
+                 verbose: bool = True,
+                 **kwargs):
+        if not any([num_epochs, num_episodes, num_actor_steps]):
+            raise ValueError(
+                "need at least one stop condition (num_epochs, num_episodes"
+                " or num_actor_steps)")
+        self.epoch_loop = epoch_loop
+        self.num_epochs = num_epochs
+        self.num_episodes = num_episodes
+        self.num_actor_steps = num_actor_steps
+        self.num_eval_episodes = num_eval_episodes
+        self.eval_freq = eval_freq
+        self.epoch_batch_size = epoch_batch_size
+        self.verbose = verbose
+        # launcher-level eval settings override the epoch loop's cadence
+        # when given (reference launcher surface: launcher.py:17)
+        if eval_freq is not None and hasattr(epoch_loop,
+                                             "evaluation_interval"):
+            epoch_loop.evaluation_interval = eval_freq
+        if num_eval_episodes is not None and hasattr(epoch_loop,
+                                                     "evaluation_duration"):
+            epoch_loop.evaluation_duration = num_eval_episodes
+
+        self.epoch_counter = 0
+        self.episode_counter = 0
+        self.actor_step_counter = 0
+
+    # -------------------------------------------------------------- control
+    def _should_stop(self) -> bool:
+        if self.num_epochs is not None and self.epoch_counter >= self.num_epochs:
+            return True
+        if (self.num_episodes is not None
+                and self.episode_counter >= self.num_episodes):
+            return True
+        if (self.num_actor_steps is not None
+                and self.actor_step_counter >= self.num_actor_steps):
+            return True
+        return False
+
+    def run(self, logger=None, checkpointer=None) -> Dict[str, Any]:
+        start = time.time()
+        last_results: Dict[str, Any] = {}
+        # checkpoint at launch, as the reference does (launcher.py:151)
+        if checkpointer is not None:
+            path = checkpointer.write(self.epoch_loop, self.epoch_counter)
+            if self.verbose:
+                print(f"Wrote initial checkpoint to {path}")
+
+        while not self._should_stop():
+            for _ in range(self.epoch_batch_size):
+                results = self.epoch_loop.run()
+                self.epoch_counter += 1
+                self.episode_counter += int(
+                    results.get("episodes_this_iter", 0))
+                self.actor_step_counter += int(
+                    results.get("env_steps_this_iter", 0))
+                last_results = results
+
+                if logger is not None:
+                    freq = getattr(logger, "epoch_log_freq", 1) or 1
+                    if self.epoch_counter % freq == 0:
+                        logger.log({"epochs": [self._scalarise(results)]})
+                        logger.save()
+                self.epoch_loop.log(results)
+
+                if (checkpointer is not None
+                        and checkpointer.should_checkpoint(
+                            self.epoch_counter)):
+                    path = checkpointer.write(self.epoch_loop,
+                                              self.epoch_counter)
+                    self.epoch_loop.register_checkpoint(path, results)
+
+                if self.verbose:
+                    msg = (f"epoch {self.epoch_counter}"
+                           f" | env steps {self.actor_step_counter}"
+                           f" | episodes {self.episode_counter}")
+                    ev = results.get("evaluation", {})
+                    if "episode_reward_mean" in ev:
+                        msg += (" | eval reward "
+                                f"{ev['episode_reward_mean']:.3f}")
+                    elif "episode_reward_mean" in results:
+                        msg += (" | reward "
+                                f"{results['episode_reward_mean']:.3f}")
+                    print(msg, flush=True)
+                if self._should_stop():
+                    break
+
+        if logger is not None:
+            logger.save(blocking=True)
+        total_time = time.time() - start
+        summary = {
+            "epochs_run": self.epoch_counter,
+            "episodes_run": self.episode_counter,
+            "actor_steps_run": self.actor_step_counter,
+            "wall_time": total_time,
+            "best_checkpoint": getattr(self.epoch_loop,
+                                       "best_checkpoint_path", None),
+            "best_metric_value": getattr(self.epoch_loop,
+                                         "best_metric_value", None),
+            "final_results": last_results,
+        }
+        if self.verbose:
+            print(f"Run complete: {self.epoch_counter} epochs in "
+                  f"{total_time:.1f}s")
+        return summary
+
+    @staticmethod
+    def _scalarise(results: Dict[str, Any]) -> Dict[str, Any]:
+        """Strip bulky per-episode payloads before logging."""
+        out = {k: v for k, v in results.items() if k != "episodes"}
+        return out

@@ -1,0 +1,156 @@
+"""Background-thread results persistence.
+
+Mirrors the reference Logger (ddls/loggers/logger.py:11): accumulates nested
+result dicts in memory and periodically writes them to disk on a background
+thread, either as one gzip-pickle per log name or into a SQLite database
+(the reference uses ``sqlitedict``, which is not available here; a small
+stdlib-``sqlite3`` key/value table provides the same shape). When SQLite is
+used, in-memory logs are cleared after each flush so long runs stay bounded
+(reference: logger.py:55-97).
+"""
+from __future__ import annotations
+
+import gzip
+import pickle
+import sqlite3
+import threading
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+
+class SqliteDict:
+    """Minimal persistent dict over stdlib sqlite3 (sqlitedict stand-in).
+
+    Values are pickled; ``update_nested`` merges list-valued keys by
+    extension so periodic flushes accumulate instead of overwrite.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._conn = sqlite3.connect(self.path)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (key TEXT PRIMARY KEY, val BLOB)")
+        self._conn.commit()
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        self._conn.execute(
+            "REPLACE INTO kv (key, val) VALUES (?, ?)",
+            (key, pickle.dumps(value)))
+
+    def __getitem__(self, key: str) -> Any:
+        row = self._conn.execute(
+            "SELECT val FROM kv WHERE key = ?", (key,)).fetchone()
+        if row is None:
+            raise KeyError(key)
+        return pickle.loads(row[0])
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def keys(self):
+        return [r[0] for r in
+                self._conn.execute("SELECT key FROM kv").fetchall()]
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.commit()
+        self._conn.close()
+
+
+def _merge_log(old: Any, new: Any) -> Any:
+    """Extend-by-key merge used when flushing incrementally."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        out = dict(old)
+        for k, v in new.items():
+            out[k] = _merge_log(out.get(k), v) if k in out else v
+        return out
+    if isinstance(old, list) and isinstance(new, list):
+        return old + new
+    return new
+
+
+class Logger:
+    """Accumulate + persist experiment results.
+
+    Args mirror the reference config surface (logger block of
+    rllib_config.yaml). ``epoch_log_freq`` is read by the Launcher to gate
+    how often epoch results are logged+flushed; the episode/actor-step
+    frequencies are carried for config parity and for custom loops that log
+    at those granularities.
+    """
+
+    def __init__(self,
+                 path_to_save: Optional[str] = None,
+                 actor_step_log_freq: Optional[int] = None,
+                 episode_log_freq: Optional[int] = None,
+                 epoch_log_freq: Optional[int] = 1,
+                 use_sqlite_database: bool = False,
+                 **kwargs):
+        self.path_to_save = path_to_save
+        self.actor_step_log_freq = actor_step_log_freq
+        self.episode_log_freq = episode_log_freq
+        self.epoch_log_freq = epoch_log_freq
+        self.use_sqlite_database = use_sqlite_database
+        self.results: Dict[str, Any] = {}
+        self._save_thread: Optional[threading.Thread] = None
+        if self.path_to_save is not None:
+            Path(self.path_to_save).mkdir(parents=True, exist_ok=True)
+
+    # -------------------------------------------------------------- logging
+    def log(self, results: Dict[str, Any]) -> None:
+        """Merge one round of results (lists extend, scalars overwrite)."""
+        self.results = _merge_log(self.results, results)
+
+    def save(self, name: str = "results", blocking: bool = False) -> None:
+        """Persist accumulated results on a background thread (reference
+        spawns a save thread and joins the previous one: logger.py:41-53)."""
+        if self.path_to_save is None:
+            return
+        self.join()
+        snapshot = self.results
+        if self.use_sqlite_database:
+            # bounded memory: what has been handed to the writer is dropped
+            # from the in-memory accumulation (reference: logger.py:55-97)
+            self.results = {}
+        self._save_thread = threading.Thread(
+            target=self._save_data, args=(name, snapshot), daemon=True)
+        self._save_thread.start()
+        if blocking:
+            self.join()
+
+    def join(self) -> None:
+        if self._save_thread is not None:
+            self._save_thread.join()
+            self._save_thread = None
+
+    # ------------------------------------------------------------ backends
+    def _save_data(self, name: str, results: Dict[str, Any]) -> None:
+        if self.use_sqlite_database:
+            db = SqliteDict(str(Path(self.path_to_save) / f"{name}.sqlite"))
+            try:
+                for key, val in results.items():
+                    db[key] = _merge_log(db.get(key), val)
+                db.commit()
+            finally:
+                db.close()
+        else:
+            path = Path(self.path_to_save) / f"{name}.pkl.gz"
+            with gzip.open(path, "wb") as f:
+                pickle.dump(results, f)
+
+    @staticmethod
+    def load(path: str) -> Dict[str, Any]:
+        """Load a saved results file (either backend, by extension)."""
+        if str(path).endswith(".sqlite"):
+            db = SqliteDict(path)
+            try:
+                return {k: db[k] for k in db.keys()}
+            finally:
+                db.close()
+        with gzip.open(path, "rb") as f:
+            return pickle.load(f)

@@ -1,0 +1,470 @@
+"""Training and evaluation loops.
+
+TPU-native replacements for the reference's loop layer (SURVEY.md §2.7):
+
+* ``RLEpochLoop`` — replaces ``RLlibEpochLoop`` (ddls/loops/
+  rllib_epoch_loop.py:34). Instead of wrapping an RLlib Trainer (Ray
+  process topology), it owns the flax GNN policy, the mesh-sharded
+  ``PPOLearner``, and a vectorised rollout collector; ``run()`` is one
+  collect+update epoch as two jitted device programs. Accepts the
+  reference's RLlib-style ``algo_config``/``model`` dicts so the existing
+  config trees drive it unchanged.
+* ``EvalLoop`` — heuristic-actor evaluation (ddls/loops/eval_loop.py:14).
+* ``RLEvalLoop`` — trained-policy evaluation from a checkpoint
+  (ddls/loops/rllib_eval_loop.py:11).
+* ``EnvLoop`` / ``EpochLoop`` — generic episode/epoch drivers
+  (ddls/loops/env_loop.py:4, epoch_loop.py:5).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ddls_tpu.utils.common import get_class_from_path, seed_everything
+
+# RLlib PPO keys (algo/ppo.yaml) -> PPOConfig fields
+_RLLIB_TO_PPO = {
+    "lr": "lr",
+    "gamma": "gamma",
+    "lambda": "gae_lambda",
+    "lambda_": "gae_lambda",
+    "kl_coeff": "kl_coeff",
+    "kl_target": "kl_target",
+    "clip_param": "clip_param",
+    "vf_clip_param": "vf_clip_param",
+    "vf_loss_coeff": "vf_loss_coeff",
+    "entropy_coeff": "entropy_coeff",
+    "num_sgd_iter": "num_sgd_iter",
+    "sgd_minibatch_size": "sgd_minibatch_size",
+    "train_batch_size": "train_batch_size",
+    "grad_clip": "grad_clip",
+}
+
+
+def ppo_config_from_rllib(algo_config: Optional[dict]):
+    """Translate an RLlib-style PPO config dict into a ``PPOConfig``."""
+    from ddls_tpu.rl.ppo import PPOConfig
+
+    kwargs = {}
+    for src, dst in _RLLIB_TO_PPO.items():
+        if algo_config and algo_config.get(src) is not None:
+            kwargs[dst] = algo_config[src]
+    return PPOConfig(**kwargs)
+
+
+def build_policy_from_model_config(n_actions: int,
+                                   model_config: Optional[dict]):
+    """Build a ``GNNPolicy`` from the reference's model/gnn.yaml surface."""
+    from ddls_tpu.models.policy import GNNPolicy
+
+    model_config = model_config or {}
+    cmc = model_config.get("custom_model_config", {})
+    fcnet_hiddens = model_config.get("fcnet_hiddens") or (256, 256)
+    return GNNPolicy(
+        n_actions=n_actions,
+        out_features_msg=cmc.get("out_features_msg", 32),
+        out_features_hidden=cmc.get("out_features_hidden", 64),
+        out_features_node=cmc.get("out_features_node", 16),
+        out_features_graph=cmc.get("out_features_graph", 8),
+        num_rounds=cmc.get("num_rounds", 2),
+        module_depth=cmc.get("module_depth", 1),
+        activation=cmc.get("aggregator_activation", "relu"),
+        fcnet_hiddens=tuple(fcnet_hiddens),
+        fcnet_activation=model_config.get("fcnet_activation", "relu"),
+        apply_action_mask=cmc.get("apply_action_mask", True))
+
+
+def _episode_summary(episodes: List[dict]) -> Dict[str, float]:
+    if not episodes:
+        return {}
+    out: Dict[str, float] = {
+        "episode_reward_mean": float(np.mean(
+            [e["episode_return"] for e in episodes])),
+        "episode_reward_min": float(np.min(
+            [e["episode_return"] for e in episodes])),
+        "episode_reward_max": float(np.max(
+            [e["episode_return"] for e in episodes])),
+        "episode_len_mean": float(np.mean(
+            [e["episode_length"] for e in episodes])),
+        "episodes_this_iter": len(episodes),
+    }
+    # cluster custom metrics, averaged over episodes (what the reference's
+    # RLlib callback surfaces as custom_metrics: ramp_cluster/utils.py:25-73)
+    for key in ("num_jobs_completed", "num_jobs_blocked", "blocking_rate",
+                "acceptance_rate", "mean_job_completion_time",
+                "mean_job_completion_time_speedup"):
+        vals = [e[key] for e in episodes if key in e]
+        if vals:
+            out[f"custom_metrics/{key}_mean"] = float(np.mean(vals))
+    return out
+
+
+class RLEpochLoop:
+    """One PPO epoch per ``run()`` call, with periodic greedy evaluation.
+
+    ``env_config`` / ``model`` / ``algo_config`` follow the reference's
+    config surfaces; mesh/rollout sizing is TPU-specific:
+
+    * ``num_envs`` — parallel env instances (reference: PPO num_workers);
+    * ``rollout_length`` — steps per env per epoch (derived from
+      train_batch_size when omitted);
+    * ``n_devices`` — mesh size for the dp axis (defaults to all devices).
+    """
+
+    def __init__(self,
+                 path_to_env_cls: str,
+                 env_config: dict,
+                 model: Optional[dict] = None,
+                 algo_config: Optional[dict] = None,
+                 num_envs: Optional[int] = None,
+                 rollout_length: Optional[int] = None,
+                 n_devices: Optional[int] = None,
+                 use_parallel_envs: bool = False,
+                 metric: str = "evaluation/episode_reward_mean",
+                 metric_goal: str = "maximise",
+                 evaluation_interval: Optional[int] = 1,
+                 evaluation_duration: int = 3,
+                 evaluation_config: Optional[dict] = None,
+                 seed: Optional[int] = 0,
+                 test_seed: Optional[int] = None,
+                 wandb=None,
+                 path_to_model_cls: Optional[str] = None,  # config parity
+                 **kwargs):
+        import jax
+
+        from ddls_tpu.parallel.mesh import make_mesh
+        from ddls_tpu.rl.ppo import PPOLearner
+        from ddls_tpu.rl.rollout import (ParallelVectorEnv, RolloutCollector,
+                                         VectorEnv)
+
+        self.env_cls = get_class_from_path(path_to_env_cls)
+        self.env_config = dict(env_config)
+        self.metric = metric
+        self.metric_goal = metric_goal
+        self.evaluation_interval = evaluation_interval
+        self.evaluation_duration = evaluation_duration
+        self.evaluation_config = evaluation_config or {}
+        self.wandb = wandb
+        self.seed = 0 if seed is None else int(seed)
+        self.test_seed = test_seed
+
+        self.ppo_cfg = ppo_config_from_rllib(algo_config)
+        self.num_envs = int(num_envs
+                            or (algo_config or {}).get("num_workers") or 8)
+        self.rollout_length = int(
+            rollout_length
+            or max(self.ppo_cfg.train_batch_size // self.num_envs, 1))
+
+        seed_everything(self.seed)
+        if use_parallel_envs:
+            self.vec_env = ParallelVectorEnv(
+                self.env_cls, self.env_config, self.num_envs,
+                seeds=[self.seed + i for i in range(self.num_envs)])
+        else:
+            self.vec_env = VectorEnv(
+                [lambda: self.env_cls(**self.env_config)
+                 for _ in range(self.num_envs)],
+                seeds=[self.seed + i for i in range(self.num_envs)])
+        self.vec_env.reset()
+
+        template_env = getattr(self.vec_env, "envs", [None])[0]
+        if template_env is not None:
+            n_actions = template_env.action_space.n
+        else:
+            n_actions = int(np.asarray(
+                self.vec_env.obs[0]["action_mask"]).shape[0])
+        self.n_actions = n_actions
+        self.model = build_policy_from_model_config(n_actions, model)
+
+        obs0 = jax.tree_util.tree_map(np.asarray, self.vec_env.obs[0])
+        self.params = self.model.init(jax.random.PRNGKey(self.seed), obs0)
+
+        from ddls_tpu.models.policy import batched_policy_apply
+        self.mesh = make_mesh(n_devices)
+        self.learner = PPOLearner(
+            lambda p, o: batched_policy_apply(self.model, p, o),
+            self.ppo_cfg, self.mesh)
+        self.state = self.learner.init_state(self.params)
+        self.collector = RolloutCollector(self.vec_env, self.learner,
+                                          self.rollout_length)
+        self.collector._needs_reset = False  # already reset above
+
+        self._rng = jax.random.PRNGKey(self.seed + 1)
+        self.epoch_counter = 0
+        self.total_env_steps = 0
+        self.best_metric_value: Optional[float] = None
+        self.best_checkpoint_path: Optional[str] = None
+        self.checkpoint_history: List[dict] = []
+        self.run_time = 0.0
+
+    # ----------------------------------------------------------------- epoch
+    def _split_rng(self):
+        import jax
+
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def run(self) -> Dict[str, Any]:
+        """Collect one trajectory batch and apply one PPO update."""
+        import jax
+
+        start = time.time()
+        out = self.collector.collect(self.state.params, self._split_rng())
+        straj, slv = self.learner.shard_traj(out["traj"], out["last_values"])
+        self.state, metrics = self.learner.train_step(
+            self.state, straj, slv, self._split_rng())
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+        self.epoch_counter += 1
+        self.total_env_steps += out["env_steps"]
+        results: Dict[str, Any] = {
+            "epoch_counter": self.epoch_counter,
+            "env_steps_this_iter": out["env_steps"],
+            "total_env_steps": self.total_env_steps,
+            "learner": metrics,
+        }
+        results.update(_episode_summary(out["episodes"]))
+        results["episodes"] = out["episodes"]
+
+        if (self.evaluation_interval
+                and self.epoch_counter % self.evaluation_interval == 0):
+            results["evaluation"] = self.evaluate(self.evaluation_duration)
+        self.run_time += time.time() - start
+        results["epoch_time"] = time.time() - start
+        results["run_time"] = self.run_time
+        return results
+
+    # ------------------------------------------------------------ evaluation
+    def make_eval_env(self):
+        """Build the evaluation env: training env_config with the
+        evaluation_config env overrides applied (eval_default.yaml
+        evaluation_config.env_config surface)."""
+        import copy
+
+        from ddls_tpu.utils.common import recursive_update
+
+        env_config = copy.deepcopy(self.env_config)
+        eval_env_overrides = (self.evaluation_config or {}).get(
+            "env_config") or {}
+        env_config = recursive_update(env_config, eval_env_overrides)
+        return self.env_cls(**env_config)
+
+    def evaluate(self, num_episodes: int,
+                 seed: Optional[int] = None) -> Dict[str, Any]:
+        """Greedy-policy evaluation episodes on a fresh env (the reference
+        evaluates with explore=False on eval workers: eval_default.yaml).
+
+        The process-global RNG state is snapshotted around evaluation:
+        env.reset(seed) seeds numpy/random globally, and letting the fixed
+        test seed leak into the training envs' workload sampling would both
+        corrupt training stochasticity and contaminate the held-out test
+        stream."""
+        import random as _random
+
+        np_state = np.random.get_state()
+        py_state = _random.getstate()
+        try:
+            env = self.make_eval_env()
+            base_seed = (seed if seed is not None
+                         else (self.test_seed
+                               if self.test_seed is not None
+                               else self.seed + 10_000))
+            episodes = []
+            for ep in range(num_episodes):
+                record = self._run_greedy_episode(env, base_seed + ep)
+                episodes.append(record)
+            return _episode_summary(episodes)
+        finally:
+            np.random.set_state(np_state)
+            _random.setstate(py_state)
+
+    def _run_greedy_episode(self, env, seed: int) -> Dict[str, Any]:
+        import jax
+
+        from ddls_tpu.rl.rollout import harvest_episode_record
+
+        obs = env.reset(seed=seed)
+        done = False
+        total, steps = 0.0, 0
+        while not done:
+            batched = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[None], obs)
+            logits, _ = self.learner.apply_fn(self.state.params, batched)
+            action = int(np.asarray(jax.device_get(logits))[0].argmax())
+            obs, reward, done, _ = env.step(action)
+            total += reward
+            steps += 1
+        return harvest_episode_record(env, 0, total, steps)
+
+    # ----------------------------------------------------------- checkpoints
+    def save_agent_checkpoint(self, path: str) -> str:
+        from ddls_tpu.train.checkpointer import save_train_state
+
+        save_train_state(self.state, path)
+        return path
+
+    def load_agent_checkpoint(self, path: str) -> None:
+        from ddls_tpu.train.checkpointer import restore_train_state
+
+        self.state = restore_train_state(path, target=self.state)
+
+    @staticmethod
+    def _lookup_metric(results: Dict[str, Any], metric: str):
+        """Resolve a '/'-separated metric path, allowing keys that contain
+        literal '/' (e.g. 'evaluation/custom_metrics/blocking_rate_mean'
+        where 'custom_metrics/blocking_rate_mean' is one key): at each dict
+        level the longest matching '/'-joined key wins."""
+        def walk(node, segments):
+            if not segments:
+                return node
+            if not isinstance(node, dict):
+                return None
+            for cut in range(len(segments), 0, -1):
+                key = "/".join(segments[:cut])
+                if key in node:
+                    found = walk(node[key], segments[cut:])
+                    if found is not None:
+                        return found
+            return None
+
+        return walk(results, metric.split("/"))
+
+    def register_checkpoint(self, path: str,
+                            results: Dict[str, Any]) -> None:
+        """Track the best checkpoint by the configured metric (reference:
+        rllib_epoch_loop.py:184-227)."""
+        value = self._lookup_metric(results, self.metric)
+        record = {"epoch": self.epoch_counter, "path": path,
+                  "metric": self.metric, "value": value}
+        self.checkpoint_history.append(record)
+        if value is None:
+            return
+        better = (self.best_metric_value is None
+                  or (value > self.best_metric_value
+                      if self.metric_goal == "maximise"
+                      else value < self.best_metric_value))
+        if better:
+            self.best_metric_value = value
+            self.best_checkpoint_path = path
+
+    # ---------------------------------------------------------------- misc
+    def log(self, results: Dict[str, Any]) -> None:
+        """Flatten scalars to W&B if configured (reference:
+        rllib_epoch_loop.py:144)."""
+        if self.wandb is None:
+            return
+        flat = {}
+
+        def walk(node, prefix=""):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    walk(v, f"{prefix}{k}/")
+            elif isinstance(node, (int, float, np.floating, np.integer)):
+                flat[prefix[:-1]] = float(node)
+
+        walk(results)
+        self.wandb.log(flat)
+
+    def close(self) -> None:
+        self.vec_env.close()
+
+
+class EvalLoop:
+    """Heuristic-actor evaluation (reference: ddls/loops/eval_loop.py:14).
+
+    ``actor`` implements ``compute_action(obs, job_to_place=...)``; results
+    harvest the cluster's steps_log and episode_stats.
+    """
+
+    def __init__(self, env, actor, wandb=None, verbose: bool = False,
+                 **kwargs):
+        self.env = env
+        self.actor = actor
+        self.wandb = wandb
+        self.verbose = verbose
+
+    def run(self, seed: Optional[int] = None,
+            max_steps: Optional[int] = None) -> Dict[str, Any]:
+        obs = self.env.reset(seed=seed)
+        done, steps, total_reward = False, 0, 0.0
+        start = time.time()
+        while not done and (max_steps is None or steps < max_steps):
+            job = None
+            queue = getattr(self.env.cluster, "job_queue", None)
+            if queue is not None and len(queue.jobs):
+                job = list(queue.jobs.values())[0]
+            action = self.actor.compute_action(obs, job_to_place=job)
+            obs, reward, done, _ = self.env.step(action)
+            total_reward += reward
+            steps += 1
+            if self.verbose:
+                print(f"step {steps}: action={action} reward={reward:.4f}")
+        results = {
+            "episode_return": total_reward,
+            "episode_length": steps,
+            "wall_time": time.time() - start,
+            "episode_stats": dict(self.env.cluster.episode_stats),
+            "steps_log": {k: list(v)
+                          for k, v in self.env.cluster.steps_log.items()},
+        }
+        if self.wandb is not None:
+            self.wandb.log({"eval/episode_return": total_reward,
+                            "eval/episode_length": steps})
+        return results
+
+
+class RLEvalLoop:
+    """Checkpoint-restoring policy evaluation (reference:
+    ddls/loops/rllib_eval_loop.py:11)."""
+
+    def __init__(self, epoch_loop: RLEpochLoop, **kwargs):
+        self.epoch_loop = epoch_loop
+
+    def run(self, checkpoint_path: Optional[str] = None,
+            seed: Optional[int] = None) -> Dict[str, Any]:
+        if checkpoint_path:
+            self.epoch_loop.load_agent_checkpoint(checkpoint_path)
+        env = self.epoch_loop.make_eval_env()
+        record = self.epoch_loop._run_greedy_episode(
+            env, seed if seed is not None
+            else (self.epoch_loop.test_seed or 0))
+        return {
+            "episode": record,
+            "episode_stats": dict(env.cluster.episode_stats),
+            "steps_log": {k: list(v)
+                          for k, v in env.cluster.steps_log.items()},
+        }
+
+
+class EnvLoop:
+    """Generic single-episode driver (reference: ddls/loops/env_loop.py:4)."""
+
+    def __init__(self, env, actor):
+        self.env = env
+        self.actor = actor
+
+    def run(self, seed: Optional[int] = None) -> Dict[str, Any]:
+        obs = self.env.reset(seed=seed)
+        done, steps, total = False, 0, 0.0
+        while not done:
+            action = self.actor.compute_action(obs)
+            obs, reward, done, _ = self.env.step(action)
+            total += reward
+            steps += 1
+        return {"episode_return": total, "episode_length": steps}
+
+
+class EpochLoop:
+    """Generic batch-of-episodes driver (reference:
+    ddls/loops/epoch_loop.py:5)."""
+
+    def __init__(self, env_loop: EnvLoop, episodes_per_epoch: int = 1):
+        self.env_loop = env_loop
+        self.episodes_per_epoch = episodes_per_epoch
+
+    def run(self) -> Dict[str, Any]:
+        episodes = [self.env_loop.run()
+                    for _ in range(self.episodes_per_epoch)]
+        return {"episodes": episodes}

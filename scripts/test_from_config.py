@@ -1,0 +1,81 @@
+"""Evaluate a trained policy checkpoint from a composed YAML config.
+
+TPU-native equivalent of the reference's scripts/test_rllib_from_config.py
+(SURVEY.md §3.3): rebuild the epoch loop from the training config (with
+eval_config overrides applied to the env), restore the checkpoint
+(epoch_loop.test_time_checkpoint_path unless overridden), run evaluation
+episodes with the greedy policy, persist harvested stats.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddls_tpu.config import load_config, save_config
+from ddls_tpu.train import Logger, RLEpochLoop, RLEvalLoop
+from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
+from train_from_config import build_epoch_loop_kwargs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config-path",
+                        default=os.path.join(os.path.dirname(__file__),
+                                             "ramp_job_partitioning_configs"))
+    parser.add_argument("--config-name", default="rllib_config")
+    parser.add_argument("--checkpoint", default=None,
+                        help="overrides epoch_loop.test_time_checkpoint_path")
+    parser.add_argument("--num-episodes", type=int, default=1)
+    parser.add_argument("overrides", nargs="*")
+    args = parser.parse_args(argv)
+
+    cfg = load_config(args.config_path, args.config_name, args.overrides)
+    experiment = cfg.get("experiment", {})
+    test_seed = int(experiment.get("test_seed", 0))
+    seed_everything(test_seed)
+
+    checkpoint = args.checkpoint or cfg.get("epoch_loop", {}).get(
+        "test_time_checkpoint_path")
+    if not checkpoint:
+        raise SystemExit("no checkpoint: pass --checkpoint or set "
+                         "epoch_loop.test_time_checkpoint_path")
+
+    save_dir = unique_experiment_dir(
+        experiment.get("path_to_save", "/tmp/ddls_tpu/sims"),
+        experiment.get("name", "experiment") + "_test")
+    cfg.setdefault("experiment", {})["save_dir"] = save_dir
+    save_config(cfg, os.path.join(save_dir, "config.yaml"))
+
+    kwargs = build_epoch_loop_kwargs(cfg)
+    # eval runs need no training rollout fleet
+    kwargs["num_envs"] = 1
+    kwargs["rollout_length"] = 1
+    kwargs["evaluation_interval"] = None
+    epoch_loop = RLEpochLoop(**kwargs)
+    eval_loop = RLEvalLoop(epoch_loop)
+
+    all_results = []
+    for ep in range(args.num_episodes):
+        results = eval_loop.run(
+            checkpoint_path=checkpoint if ep == 0 else None,
+            seed=test_seed + ep)
+        record = results["episode"]
+        stats = results["episode_stats"]
+        print(f"episode {ep}: return {record['episode_return']:.3f} | "
+              f"completed {stats.get('num_jobs_completed')} | "
+              f"blocked {stats.get('num_jobs_blocked')}")
+        all_results.append(results)
+
+    logger = Logger(path_to_save=save_dir, **(cfg.get("logger") or {}))
+    logger.log({"rl_eval": all_results})
+    logger.save(blocking=True)
+    print(f"Saved results under {save_dir}")
+    epoch_loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

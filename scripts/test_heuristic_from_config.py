@@ -1,0 +1,77 @@
+"""Evaluate a heuristic baseline actor from a composed YAML config.
+
+TPU-native equivalent of the reference's scripts/test_heuristic_from_config
+(SURVEY.md §3.4): instantiate the ``eval_loop`` block (_target_ EvalLoop
+with env + actor), run one evaluation episode, persist harvested stats.
+Supports the reference's optional cProfile wrap
+(test_heuristic_from_config.py:73-84) via experiment.profile_time.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddls_tpu.config import instantiate, load_config, save_config
+from ddls_tpu.train import Logger
+from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config-path",
+                        default=os.path.join(os.path.dirname(__file__),
+                                             "ramp_job_partitioning_configs"))
+    parser.add_argument("--config-name", default="heuristic_config")
+    parser.add_argument("overrides", nargs="*")
+    args = parser.parse_args(argv)
+
+    cfg = load_config(args.config_path, args.config_name, args.overrides)
+    experiment = cfg.get("experiment", {})
+    seed = int(experiment.get("seed", 0))
+    seed_everything(seed)
+
+    save_dir = unique_experiment_dir(
+        experiment.get("path_to_save", "/tmp/ddls_tpu/sims"),
+        experiment.get("name", "heuristic"))
+    cfg.setdefault("experiment", {})["save_dir"] = save_dir
+    save_config(cfg, os.path.join(save_dir, "config.yaml"))
+
+    eval_loop = instantiate(cfg["eval_loop"])
+    print(f"Initialised EvalLoop with actor "
+          f"{type(eval_loop.actor).__name__}")
+
+    if experiment.get("profile_time"):
+        import cProfile
+        import pstats
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+        results = eval_loop.run(seed=seed)
+        profiler.disable()
+        prof_path = os.path.join(save_dir, "profile.prof")
+        profiler.dump_stats(prof_path)
+        pstats.Stats(profiler).sort_stats("cumulative").print_stats(20)
+        print(f"Saved profile to {prof_path}")
+    else:
+        results = eval_loop.run(seed=seed)
+
+    stats = results["episode_stats"]
+    print(f"episode return {results['episode_return']:.3f} over "
+          f"{results['episode_length']} steps | "
+          f"completed {stats.get('num_jobs_completed')} | "
+          f"blocked {stats.get('num_jobs_blocked')} | "
+          f"blocking rate {stats.get('blocking_rate')}")
+
+    logger = Logger(path_to_save=save_dir,
+                    **(cfg.get("logger") or {}))
+    logger.log({"heuristic_eval": results})
+    logger.save(blocking=True)
+    print(f"Saved results under {save_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

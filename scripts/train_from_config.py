@@ -1,0 +1,112 @@
+"""Train a PAC-ML policy from a composed YAML config.
+
+TPU-native equivalent of the reference's scripts/train_rllib_from_config.py
+(SURVEY.md §3.1): composes the config-group tree, seeds globally, builds the
+epoch loop (merging algo/model/env_config/eval_config groups into its
+kwargs exactly as the reference merges them into the RLlib config), then
+runs Launcher + Logger + Checkpointer. Instead of CUDA device picking and
+Ray worker spawning, device discovery is ``jax.devices()`` on the pod
+slice/chip this process owns.
+
+Usage:
+    python scripts/train_from_config.py \
+        [--config-path scripts/ramp_job_partitioning_configs] \
+        [--config-name rllib_config] \
+        [launcher.num_epochs=3 algo=ppo env_config=env_dev ...]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddls_tpu.config import load_config, save_config
+from ddls_tpu.train import Checkpointer, Launcher, Logger, RLEpochLoop
+from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
+
+
+def build_epoch_loop_kwargs(cfg: dict) -> dict:
+    """Merge config groups into epoch-loop kwargs (the reference merges the
+    same groups into cfg.epoch_loop.rllib_config:
+    train_rllib_from_config.py:46-64)."""
+    kwargs = {k: v for k, v in cfg.get("epoch_loop", {}).items()
+              if k != "_target_"}
+    if "env_config" in cfg:
+        kwargs["env_config"] = cfg["env_config"]
+    if "model" in cfg:
+        import copy
+
+        model = copy.deepcopy(cfg["model"])  # don't alias/mutate cfg
+        algo_model = (cfg.get("algo") or {}).get("model")
+        if algo_model:
+            from ddls_tpu.utils.common import recursive_update
+            model = recursive_update(model, copy.deepcopy(algo_model))
+        kwargs["model"] = model
+    if "algo" in cfg:
+        kwargs["algo_config"] = cfg["algo"].get("algo_config", {})
+    if "eval_config" in cfg:
+        for key in ("evaluation_interval", "evaluation_duration",
+                    "evaluation_config"):
+            if key in cfg["eval_config"]:
+                kwargs[key] = cfg["eval_config"][key]
+    experiment = cfg.get("experiment", {})
+    if "train_seed" in experiment:
+        kwargs["seed"] = experiment["train_seed"]
+    if "test_seed" in experiment:
+        kwargs["test_seed"] = experiment["test_seed"]
+    return kwargs
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config-path",
+                        default=os.path.join(os.path.dirname(__file__),
+                                             "ramp_job_partitioning_configs"))
+    parser.add_argument("--config-name", default="rllib_config")
+    parser.add_argument("overrides", nargs="*",
+                        help="dotted-path overrides, e.g. launcher.num_epochs=3")
+    args = parser.parse_args(argv)
+
+    cfg = load_config(args.config_path, args.config_name, args.overrides)
+    experiment = cfg.get("experiment", {})
+
+    seed_everything(int(experiment.get("train_seed", 0)))
+
+    save_dir = unique_experiment_dir(
+        experiment.get("path_to_save", "/tmp/ddls_tpu/sims"),
+        experiment.get("name", "experiment"))
+    cfg.setdefault("experiment", {})["save_dir"] = save_dir
+    save_config(cfg, os.path.join(save_dir, "config.yaml"))
+    print(f"Experiment save dir: {save_dir}")
+
+    wandb = None
+    if cfg.get("wandb"):
+        try:
+            import wandb as wandb_module
+
+            wandb_module.init(config=cfg, **cfg["wandb"].get("init", {}))
+            wandb = wandb_module
+        except ImportError:
+            print("wandb requested but not installed; continuing without it")
+
+    epoch_loop = RLEpochLoop(wandb=wandb, **build_epoch_loop_kwargs(cfg))
+    print(f"Initialised RLEpochLoop: {epoch_loop.num_envs} envs x "
+          f"{epoch_loop.rollout_length} steps on mesh "
+          f"{dict(epoch_loop.mesh.shape)}")
+
+    launcher = Launcher(epoch_loop=epoch_loop, **cfg.get("launcher", {}))
+    logger = Logger(path_to_save=save_dir, **cfg.get("logger", {}))
+    checkpointer = Checkpointer(path_to_save=save_dir,
+                                **cfg.get("checkpointer", {}))
+
+    summary = launcher.run(logger=logger, checkpointer=checkpointer)
+    print(f"Best checkpoint: {summary['best_checkpoint']} "
+          f"({epoch_loop.metric}={summary['best_metric_value']})")
+    epoch_loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
