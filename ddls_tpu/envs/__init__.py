@@ -1,4 +1,8 @@
 from ddls_tpu.envs.partitioning_env import RampJobPartitioningEnvironment
+from ddls_tpu.envs.placement_shaping_env import (
+    RampJobPlacementShapingEnvironment)
 from ddls_tpu.envs import baselines, rewards, spaces
 
-__all__ = ["RampJobPartitioningEnvironment", "baselines", "rewards", "spaces"]
+__all__ = ["RampJobPartitioningEnvironment",
+           "RampJobPlacementShapingEnvironment", "baselines", "rewards",
+           "spaces"]

@@ -119,3 +119,45 @@ BASELINE_ACTORS = {
     cls.name: cls for cls in (RandomActor, NoParallelism, MinParallelism,
                               MaxParallelism, SiPML, AcceptableJCT)
 }
+
+
+# ---------------------------------------------------------------------------
+# Placement-shaping baseline actors (reference:
+# ddls/environments/ramp_job_placement_shaping/agents/*.py): choose among
+# valid meta-block shape actions; action 0 (don't place) is only taken when
+# it is the sole valid action.
+
+class FirstFitShaper(BaselineActor):
+    """First valid non-zero shape action."""
+
+    name = "first_fit"
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        valid = _valid_actions(obs)
+        return int(valid[1] if len(valid) > 1 else valid[0])
+
+
+class LastFitShaper(BaselineActor):
+    """Last valid non-zero shape action."""
+
+    name = "last_fit"
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        return int(_valid_actions(obs)[-1])
+
+
+class RandomShaper(BaselineActor):
+    """Uniform-random valid non-zero shape action."""
+
+    name = "random_shaper"
+
+    def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
+        valid = _valid_actions(obs)
+        if len(valid) > 1:
+            return int(np.random.choice(valid[1:]))
+        return int(valid[0])
+
+
+SHAPER_ACTORS = {
+    cls.name: cls for cls in (FirstFitShaper, LastFitShaper, RandomShaper)
+}

@@ -1,0 +1,110 @@
+"""Observation encoder for the placement-shaping MDP.
+
+Reference: ddls/environments/ramp_job_placement_shaping/observations/
+ramp_job_placement_shaping_observation.py:77-140. Node/edge/graph features
+reuse the partitioning encoder, but the job encoded is the *partitioned*
+job (a heuristic partitioner ran before the agent acts), and the action
+space/mask covers the C*R*S+1 meta-block shapes: a shape (c, r, s) is valid
+iff the job's max partition degree <= c*r*s <= free workers AND a first-fit
+meta-block search finds a concrete placement of that shape.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ddls_tpu.agents.block_search import find_meta_block, snapshot_free_servers
+from ddls_tpu.envs import spaces
+from ddls_tpu.envs.obs import (EDGE_FEATURE_DIM, GRAPH_FEATURE_DIM,
+                               NODE_FEATURE_DIM,
+                               RampJobPartitioningObservation)
+
+
+def shape_action_table(topology) -> dict:
+    """action int -> (c, r, s) shape; 0 -> None (do not place). Enumeration
+    order is part of the MDP (reference:
+    ramp_job_placement_shaping_environment.py:134-141)."""
+    table = {0: None}
+    action = 1
+    for c in range(1, topology.num_communication_groups + 1):
+        for r in range(1, topology.num_racks_per_communication_group + 1):
+            for s in range(1, topology.num_servers_per_rack + 1):
+                table[action] = (c, r, s)
+                action += 1
+    return table
+
+
+class RampJobPlacementShapingObservation(RampJobPartitioningObservation):
+    def __init__(self, pad_obs_kwargs: Optional[dict] = None,
+                 machine_epsilon: float = 1e-7):
+        # max_partitions_per_op is unused by the shaping action space; the
+        # base class only needs it for its own mask, which we override
+        super().__init__(max_partitions_per_op=0,
+                         pad_obs_kwargs=pad_obs_kwargs,
+                         machine_epsilon=machine_epsilon)
+        self._n_actions: Optional[int] = None
+
+    def reset(self, env) -> None:
+        topo = env.cluster.topology
+        self._n_actions = (topo.num_communication_groups
+                           * topo.num_racks_per_communication_group
+                           * topo.num_servers_per_rack + 1)
+        n_actions = self._n_actions
+        if self.max_nodes:
+            max_n, max_e = self.max_nodes, self.max_edges
+        else:
+            job = self._job_to_encode(env)
+            max_n, max_e = job.graph.n_ops, job.graph.n_deps
+        self.observation_space = spaces.Dict({
+            "action_set": spaces.Box(0, n_actions - 1, (n_actions,),
+                                     np.int32),
+            "action_mask": spaces.Box(0, 1, (n_actions,), np.int32),
+            "node_features": spaces.Box(
+                0.0, 1.0, (max_n, NODE_FEATURE_DIM), np.float32),
+            "edge_features": spaces.Box(
+                0.0, 1.0, (max_e, EDGE_FEATURE_DIM), np.float32),
+            "graph_features": spaces.Box(
+                0.0, 1.0, (GRAPH_FEATURE_DIM + n_actions,), np.float32),
+            "edges_src": spaces.Box(0, max_n - 1, (max_e,), np.int32),
+            "edges_dst": spaces.Box(0, max_n - 1, (max_e,), np.int32),
+            "node_split": spaces.Box(0, max_n, (1,), np.int32),
+            "edge_split": spaces.Box(0, max_e, (1,), np.int32),
+        })
+
+    # --------------------------------------------------------------- encode
+    def _job_to_encode(self, env):
+        """The partitioned job awaiting a shape decision."""
+        if env.op_partition is not None and env.op_partition.partitioned_jobs:
+            return next(iter(env.op_partition.partitioned_jobs.values()))
+        return list(env.cluster.job_queue.jobs.values())[0]
+
+    def extract(self, env, done: bool):
+        return self.encode(self._job_to_encode(env), env)
+
+    def get_action_set_and_mask(self, env):
+        topo = env.cluster.topology
+        ramp_shape = (topo.num_communication_groups,
+                      topo.num_racks_per_communication_group,
+                      topo.num_servers_per_rack)
+        ramp = snapshot_free_servers(env.cluster)
+        free_workers = sum(
+            1 for w in topo.workers.values() if not w.mounted_job_idx_to_ops)
+
+        action_set = np.arange(self._n_actions, dtype=np.int32)
+        mask = np.zeros(self._n_actions, dtype=np.int32)
+        mask[0] = 1  # not placing is always valid
+        if env.op_partition is None or not env.op_partition.partitioned_jobs:
+            mask[:] = 1
+            return action_set, mask
+        job_id = next(iter(env.op_partition.partitioned_jobs))
+        degree = env.op_partition.job_id_to_max_partition_degree[job_id]
+        for action, shape in env.action_to_shape.items():
+            if shape is None:
+                continue
+            c, r, s = shape
+            if not (degree <= c * r * s <= free_workers):
+                continue
+            if find_meta_block(ramp, ramp_shape, shape) is not None:
+                mask[action] = 1
+        return action_set, mask

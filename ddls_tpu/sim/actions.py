@@ -94,6 +94,20 @@ class OpPartition:
         return len(self.action)
 
 
+class JobPlacementShape:
+    """job -> (c, r, s) meta-block shape chosen for the job (reference:
+    actions/job_placement_shape.py:1). Consumed by the placement-shaping
+    env/placer; carried on the composite Action for parity."""
+
+    def __init__(self, action: Dict[int, Tuple[int, int, int]]):
+        self.action = {job_id: tuple(shape)
+                       for job_id, shape in action.items()}
+        self.job_ids: Set[int] = set(self.action)
+
+    def __len__(self) -> int:
+        return len(self.action)
+
+
 class OpPlacement:
     """job -> op -> worker map; prices all dependency run times on
     construction (reference: actions/op_placement.py:7 + actions/utils.py:13
@@ -160,7 +174,9 @@ class Action:
                  op_placement: Optional[OpPlacement] = None,
                  op_schedule: Optional[OpSchedule] = None,
                  dep_placement: Optional[DepPlacement] = None,
-                 dep_schedule: Optional[DepSchedule] = None):
+                 dep_schedule: Optional[DepSchedule] = None,
+                 job_placement_shape: Optional[JobPlacementShape] = None):
+        self.job_placement_shape = job_placement_shape
         self.actions = {
             "op_partition": op_partition,
             "op_placement": op_placement,

@@ -96,6 +96,12 @@ _REFERENCE_CLASS_ALIASES = {
         "ddls_tpu.envs.baselines.SiPML",
     "ddls.environments.ramp_job_partitioning.agents.acceptable_jct.AcceptableJCT":
         "ddls_tpu.envs.baselines.AcceptableJCT",
+    "ddls.environments.ramp_job_placement_shaping.agents.first_fit.FirstFit":
+        "ddls_tpu.envs.baselines.FirstFitShaper",
+    "ddls.environments.ramp_job_placement_shaping.agents.last_fit.LastFit":
+        "ddls_tpu.envs.baselines.LastFitShaper",
+    "ddls.environments.ramp_job_placement_shaping.agents.random.Random":
+        "ddls_tpu.envs.baselines.RandomShaper",
 }
 
 
