@@ -20,13 +20,44 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import tempfile
 import time
+import traceback
 
 import numpy as np
 
 REFERENCE_ENV_STEPS_PER_SEC = 240.0  # documented estimate, see module docstring
+BASELINE_SOURCE = "estimate"  # reference publishes no numbers (BASELINE.json)
+
+
+def emit(payload: dict) -> None:
+    """The driver parses exactly one JSON line from stdout."""
+    print(json.dumps(payload), flush=True)
+
+
+def probe_backend(timeout: float, force_cpu: bool = False) -> str | None:
+    """Bounded jax-backend-init probe in a subprocess.
+
+    Returns None if the backend initialises within ``timeout`` seconds, else
+    a one-line diagnostic. Round 1 died here: the axon TPU backend
+    hung/errored during init and bench.py produced no JSON at all. The CPU
+    fallback needs ``jax.config.update`` (not just JAX_PLATFORMS) — site
+    hooks can pin an accelerator backend before env vars are consulted.
+    """
+    pin = ('jax.config.update("jax_platforms", "cpu"); ' if force_cpu else "")
+    code = f"import jax; {pin}d = jax.devices(); print(len(d), d[0].platform)"
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, timeout=timeout,
+                             env=os.environ.copy())
+    except subprocess.TimeoutExpired:
+        return f"jax backend init timed out after {timeout:.0f}s"
+    if out.returncode == 0:
+        return None
+    tail = (out.stderr or "").strip().splitlines()
+    return tail[-1] if tail else f"jax backend probe exited rc={out.returncode}"
 
 
 def make_env_kwargs(dataset_dir: str) -> dict:
@@ -72,33 +103,92 @@ def make_env_fn(dataset_dir: str):
     return fn
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--num-envs", type=int, default=8)
-    parser.add_argument("--rollout-length", type=int, default=32)
-    parser.add_argument("--timed-epochs", type=int, default=3)
-    parser.add_argument("--warmup-epochs", type=int, default=1)
-    parser.add_argument("--num-sgd-iter", type=int, default=50)
-    args = parser.parse_args(argv)
+def _available_cores() -> int:
+    from ddls_tpu.utils.common import available_cores
 
-    import jax
+    return available_cores()
 
+
+def _make_vec_env(dataset_dir: str, num_envs: int):
+    """Subprocess workers when there are cores for them, else in-process."""
     from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.rl.rollout import ParallelVectorEnv, VectorEnv
+
+    kwargs = make_env_kwargs(dataset_dir)
+    seeds = list(range(num_envs))
+    if _available_cores() > 1:
+        return ParallelVectorEnv(RampJobPartitioningEnvironment, kwargs,
+                                 num_envs, seeds=seeds)
+    return VectorEnv([lambda: RampJobPartitioningEnvironment(**kwargs)
+                      for _ in range(num_envs)], seeds=seeds)
+
+
+def _make_dataset() -> str:
     from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
-    from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
-    from ddls_tpu.parallel.mesh import make_mesh
-    from ddls_tpu.rl.ppo import PPOConfig, PPOLearner
-    from ddls_tpu.rl.rollout import ParallelVectorEnv, RolloutCollector
 
     dataset_dir = tempfile.mkdtemp(prefix="bench_small_graphs_")
     generate_pipedream_txt_files(dataset_dir, n_cnn=3, n_translation=2,
                                  seed=0, min_ops=8, max_ops=16)
+    return dataset_dir
+
+
+def run_sim_bench(args) -> dict:
+    """Pure simulator throughput: vectorised env stepping with random valid
+    actions, no learner in the loop. Isolates the host hot path
+    (reference hot loop: ramp_job_partitioning_environment.py:300)."""
+    vec = _make_vec_env(_make_dataset(), args.num_envs)
+    vec.reset()
+    rng = np.random.RandomState(0)
+
+    def random_actions():
+        acts = np.zeros(vec.num_envs, dtype=np.int32)
+        for i, o in enumerate(vec.obs):
+            valid = np.nonzero(np.asarray(o["action_mask"]))[0]
+            acts[i] = rng.choice(valid)
+        return acts
+
+    warmup = max(1, args.rollout_length // 2)
+    for _ in range(warmup):
+        vec.step(random_actions())
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < args.sim_seconds:
+        vec.step(random_actions())
+        n += vec.num_envs
+    dt = time.perf_counter() - t0
+    vec.close()
+    value = n / dt
+    return {
+        "metric": "sim_env_steps_per_sec",
+        "value": round(value, 2),
+        "unit": "env_steps/s",
+        # the 240/s estimate covers the reference's FULL ppo rollout loop
+        # (env.step + DGL build + torch inference); sim mode measures
+        # env.step only, so the ratio is not comparable — omit it
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "num_envs": args.num_envs,
+        "cores": _available_cores(),
+    }
+
+
+def run_bench(args, platform_note: str | None) -> dict:
+    import jax
+
+    from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
+    from ddls_tpu.parallel.mesh import make_mesh
+    from ddls_tpu.rl.ppo import PPOConfig, PPOLearner
+    from ddls_tpu.rl.rollout import RolloutCollector
+
+    n_dev = len(jax.devices())
+    # the trajectory batch dim is sharded over the dp axis; keep num_envs a
+    # multiple of the device count so shard_traj divides evenly
+    if args.num_envs % n_dev != 0:
+        args.num_envs = max((args.num_envs // n_dev) * n_dev, n_dev)
 
     n_actions = 17
     model = GNNPolicy(n_actions=n_actions)
-    vec = ParallelVectorEnv(RampJobPartitioningEnvironment,
-                            make_env_kwargs(dataset_dir), args.num_envs,
-                            seeds=list(range(args.num_envs)))
+    vec = _make_vec_env(_make_dataset(), args.num_envs)
     vec.reset()
     single = jax.tree_util.tree_map(np.asarray, vec.obs[0])
     params = model.init(jax.random.PRNGKey(0), single)
@@ -139,13 +229,77 @@ def main(argv=None) -> int:
 
     vec.close()
     value = total_steps / dt
-    print(json.dumps({
+    payload = {
         "metric": "ppo_env_steps_per_sec",
         "value": round(value, 2),
         "unit": "env_steps/s",
         "vs_baseline": round(value / REFERENCE_ENV_STEPS_PER_SEC, 3),
-    }))
-    return 0
+        "baseline_source": BASELINE_SOURCE,
+        "platform": jax.devices()[0].platform,
+        "num_envs": args.num_envs,  # after device-multiple rounding
+        "cores": _available_cores(),
+    }
+    if platform_note:
+        payload["platform_note"] = platform_note
+    return payload
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mode", choices=("ppo", "sim"), default="ppo",
+                        help="ppo: full train loop; sim: pure env stepping")
+    parser.add_argument("--num-envs", type=int, default=None)
+    parser.add_argument("--rollout-length", type=int, default=32)
+    parser.add_argument("--timed-epochs", type=int, default=3)
+    parser.add_argument("--warmup-epochs", type=int, default=1)
+    parser.add_argument("--num-sgd-iter", type=int, default=50)
+    parser.add_argument("--sim-seconds", type=float, default=20.0)
+    parser.add_argument("--probe-timeout", type=float, default=240.0)
+    args = parser.parse_args(argv)
+    if args.num_envs is None:
+        # one env worker per core, 8+ to match the reference's 8 rollout
+        # workers when the host has them
+        args.num_envs = max(2, min(16, _available_cores()))
+
+    if args.mode == "sim":
+        # no device in the loop: never touch the (possibly hanging) TPU
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            emit(run_sim_bench(args))
+            return 0
+        except Exception:
+            tb = traceback.format_exc().strip().splitlines()
+            emit({"metric": "sim_env_steps_per_sec", "value": None,
+                  "unit": "env_steps/s", "vs_baseline": None,
+                  "error": " | ".join(tb[-3:])})
+            return 1
+
+    platform_note = None
+    err = probe_backend(args.probe_timeout)
+    if err is not None:
+        # default (TPU) backend is broken or hanging: fall back to CPU so a
+        # measurement still lands, and carry the diagnostic in the JSON line
+        platform_note = f"default backend unusable ({err}); fell back to cpu"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        cpu_err = probe_backend(args.probe_timeout, force_cpu=True)
+        if cpu_err is not None:
+            emit({"metric": "ppo_env_steps_per_sec", "value": None,
+                  "unit": "env_steps/s", "vs_baseline": None,
+                  "error": f"tpu: {err}; cpu fallback: {cpu_err}"})
+            return 1
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        emit(run_bench(args, platform_note))
+        return 0
+    except Exception:
+        tb = traceback.format_exc().strip().splitlines()
+        emit({"metric": "ppo_env_steps_per_sec", "value": None,
+              "unit": "env_steps/s", "vs_baseline": None,
+              "error": " | ".join(tb[-3:])})
+        return 1
 
 
 if __name__ == "__main__":

@@ -25,6 +25,9 @@ from ddls_tpu.graphs.readers import backward_op_id
 from ddls_tpu.hardware.devices import channel_id as make_channel_id
 from ddls_tpu.sim.partition import partitioned_op_id
 
+# sentinel distinguishing "pair not scanned yet" from "pair has no options"
+_PAIR_UNSEEN = object()
+
 
 def _pair_memory(full_graph, op: str, b_op: str) -> float:
     """Combined memory of a forward op and its backward counterpart: both are
@@ -183,7 +186,11 @@ class RandomOpPlacer:
     def __init__(self, **kwargs):
         pass
 
-    def get(self, op_partition, cluster, verbose: bool = False):
+    def get(self, op_partition, cluster, meta_block_shapes=None,
+            verbose: bool = False):
+        # meta_block_shapes is accepted (and ignored) so this placer is
+        # drop-in compatible with the shaping env's placer call signature;
+        # parameter order mirrors RampFirstFitOpPlacer.get
         from ddls_tpu.sim.actions import OpPlacement
 
         topo = cluster.topology
@@ -230,25 +237,42 @@ class FirstFitDepPlacer:
             if job_id not in placements:
                 continue
             job_idx = partitioned.details["job_idx"]
+            placement = placements[job_id]
+            worker_to_server = topo.worker_to_server
+            op_server = {op_id: worker_to_server[w]
+                         for op_id, w in placement.items()}
+            edge_size = partitioned.graph.edge_size
             dep_to_channels: Dict[Tuple[str, str], Set[Optional[str]]] = (
                 defaultdict(set))
             channels_this_job: Set[str] = set()
+            # channel validity for a (src, dst) pair is fixed while this
+            # job's deps are being placed, so scan the path x channel space
+            # once per pair: first path with any valid channel + that path's
+            # valid channel list. Per dep, a uniform pick from the list is
+            # distribution-identical to the reference's shuffled first-fit
+            # (first_fit_dep_placer.py:118-121) at O(1) instead of
+            # O(paths x channels) per flow.
+            pair_options: Dict[Tuple[str, str], Optional[tuple]] = {}
             ok = True
             for dep_id in partitioned.graph.edge_ids:
                 u, v = dep_id
-                src_node = topo.worker_to_server[placements[job_id][u]]
-                dst_node = topo.worker_to_server[placements[job_id][v]]
-                size = partitioned.graph.edge_size(u, v)
-                if src_node == dst_node or size == 0:
+                src_node = op_server[u]
+                dst_node = op_server[v]
+                if src_node == dst_node or edge_size(u, v) == 0:
                     dep_to_channels[dep_id].add(None)
                     continue
-                found = self._first_valid_path_channel(
-                    topo, src_node, dst_node, job_idx,
-                    channels_used_by_other_jobs)
-                if found is None:
+                key = (src_node, dst_node)
+                options = pair_options.get(key, _PAIR_UNSEEN)
+                if options is _PAIR_UNSEEN:
+                    options = self._valid_path_channels(
+                        topo, src_node, dst_node, job_idx,
+                        channels_used_by_other_jobs)
+                    pair_options[key] = options
+                if options is None:
                     ok = False
                     break
-                path, ch_num = found
+                path, valid_channels = options
+                ch_num = random.choice(valid_channels)
                 for idx in range(len(path) - 1):
                     ch_id = make_channel_id(path[idx], path[idx + 1], ch_num)
                     dep_to_channels[dep_id].add(ch_id)
@@ -258,19 +282,17 @@ class FirstFitDepPlacer:
                 channels_used_by_other_jobs.update(channels_this_job)
         return DepPlacement(result)
 
-    def _first_valid_path_channel(self, topo, src_node: str, dst_node: str,
-                                  job_idx: int,
-                                  channels_used_by_other_jobs: Set[str]):
-        paths = topo.shortest_paths[src_node][dst_node]
-        channel_nums = list(range(topo.num_channels))
-        # shuffle so a job's flows spread over channels
-        # (reference: first_fit_dep_placer.py:118-121)
-        random.shuffle(channel_nums)
-        for path in paths:
-            for ch_num in channel_nums:
-                if self._path_channel_valid(topo, path, ch_num, job_idx,
-                                            channels_used_by_other_jobs):
-                    return path, ch_num
+    def _valid_path_channels(self, topo, src_node: str, dst_node: str,
+                             job_idx: int,
+                             channels_used_by_other_jobs: Set[str]):
+        """First path with >=1 valid channel, plus its valid channel nums."""
+        for path in topo.shortest_paths[src_node][dst_node]:
+            valid = [ch_num for ch_num in range(topo.num_channels)
+                     if self._path_channel_valid(
+                         topo, path, ch_num, job_idx,
+                         channels_used_by_other_jobs)]
+            if valid:
+                return path, valid
         return None
 
     def _path_channel_valid(self, topo, path, ch_num: int, job_idx: int,

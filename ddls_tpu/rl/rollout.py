@@ -73,12 +73,21 @@ class VectorEnv:
         return self.obs
 
     def step(self, actions: np.ndarray):
-        rewards = np.zeros(self.num_envs, dtype=np.float32)
-        dones = np.zeros(self.num_envs, dtype=bool)
-        for i, env in enumerate(self.envs):
-            obs, reward, done, _ = env.step(int(actions[i]))
-            rewards[i] = reward
-            dones[i] = done
+        return self.step_subset(range(self.num_envs), actions)
+
+    def step_subset(self, indices, actions: np.ndarray):
+        """Step only ``envs[i] for i in indices`` with ``actions`` (same
+        length as ``indices``); returns (obs list for the subset, rewards,
+        dones). Used by the pipelined collector to overlap device sampling
+        of one env group with host stepping of the other."""
+        indices = list(indices)
+        rewards = np.zeros(len(indices), dtype=np.float32)
+        dones = np.zeros(len(indices), dtype=bool)
+        for k, i in enumerate(indices):
+            env = self.envs[i]
+            obs, reward, done, _ = env.step(int(actions[k]))
+            rewards[k] = reward
+            dones[k] = done
             self.episode_returns[i] += reward
             self.episode_lengths[i] += 1
             if done:
@@ -89,7 +98,7 @@ class VectorEnv:
                 self.episode_returns[i] = 0.0
                 self.episode_lengths[i] = 0
             self.obs[i] = obs
-        return self.obs, rewards, dones
+        return [self.obs[i] for i in indices], rewards, dones
 
     def _harvest_episode(self, i: int, env) -> None:
         self.completed_episodes.append(harvest_episode_record(
@@ -195,18 +204,23 @@ class ParallelVectorEnv:
         return self.obs
 
     def step(self, actions: np.ndarray):
-        for conn, action in zip(self._conns, actions):
-            conn.send(("step", int(action)))
-        rewards = np.zeros(self.num_envs, dtype=np.float32)
-        dones = np.zeros(self.num_envs, dtype=bool)
-        for i, conn in enumerate(self._conns):
-            _, (obs, reward, done, record) = self._recv(conn)
+        return self.step_subset(range(self.num_envs), actions)
+
+    def step_subset(self, indices, actions: np.ndarray):
+        """Step only the workers in ``indices``; see VectorEnv.step_subset."""
+        indices = list(indices)
+        for k, i in enumerate(indices):
+            self._conns[i].send(("step", int(actions[k])))
+        rewards = np.zeros(len(indices), dtype=np.float32)
+        dones = np.zeros(len(indices), dtype=bool)
+        for k, i in enumerate(indices):
+            _, (obs, reward, done, record) = self._recv(self._conns[i])
             self.obs[i] = obs
-            rewards[i] = reward
-            dones[i] = done
+            rewards[k] = reward
+            dones[k] = done
             if record is not None:
                 self.completed_episodes.append(record)
-        return self.obs, rewards, dones
+        return [self.obs[i] for i in indices], rewards, dones
 
     def drain_completed_episodes(self) -> List[Dict[str, Any]]:
         out, self.completed_episodes = self.completed_episodes, []
@@ -225,12 +239,28 @@ class ParallelVectorEnv:
 
 
 class RolloutCollector:
-    """Collects [T, B] trajectory batches for the PPO learner."""
+    """Collects [T, B] trajectory batches for the PPO learner.
 
-    def __init__(self, vec_env: VectorEnv, learner, rollout_length: int):
+    With ``pipeline=True`` (default for an even batch of >= 2 envs) the envs
+    are split into two groups and collection interleaves them: while the host
+    steps group A's simulators, the device is already computing group B's
+    action batch (jax dispatch is asynchronous), so the per-step device
+    round-trip — significant under a tunnelled TPU — is hidden behind env
+    stepping instead of serialised with it.
+    """
+
+    def __init__(self, vec_env: VectorEnv, learner, rollout_length: int,
+                 pipeline: Optional[bool] = None):
         self.vec_env = vec_env
         self.learner = learner
         self.rollout_length = rollout_length
+        B = vec_env.num_envs
+        if pipeline is None:
+            # overlap only exists when sampling runs on an accelerator; on a
+            # CPU backend the device IS the host, and two half-batch calls
+            # just double the sampling overhead
+            pipeline = B >= 2 and B % 2 == 0 and jax.default_backend() != "cpu"
+        self.pipeline = pipeline
         self._needs_reset = True
 
     def collect(self, params, rng) -> Dict[str, Any]:
@@ -240,6 +270,8 @@ class RolloutCollector:
         if self._needs_reset:
             self.vec_env.reset()
             self._needs_reset = False
+        if self.pipeline and B >= 2 and B % 2 == 0:
+            return self._collect_pipelined(params, rng)
 
         obs_buf: List[Dict[str, np.ndarray]] = []
         act_buf = np.zeros((T, B), dtype=np.int32)
@@ -274,6 +306,73 @@ class RolloutCollector:
                      "values": val_buf, "rewards": rew_buf,
                      "dones": done_buf},
             "last_values": np.asarray(last_values),
+            "episodes": self.vec_env.drain_completed_episodes(),
+            "env_steps": T * B,
+        }
+
+    def _collect_pipelined(self, params, rng) -> Dict[str, Any]:
+        """Two-group interleaved collection (see class docstring).
+
+        Device-dispatch order per step t: sample(G0, t), sample(G1, t),
+        sample(G0, t+1), ... — each half's host env stepping overlaps the
+        other half's device sampling.
+        """
+        T, B = self.rollout_length, self.vec_env.num_envs
+        H = B // 2
+        groups = [list(range(H)), list(range(H, B))]
+
+        obs_buf: List[List[Dict[str, np.ndarray]]] = [[], []]
+        act_buf = np.zeros((T, B), dtype=np.int32)
+        logp_buf = np.zeros((T, B), dtype=np.float32)
+        val_buf = np.zeros((T, B), dtype=np.float32)
+        rew_buf = np.zeros((T, B), dtype=np.float32)
+        done_buf = np.zeros((T, B), dtype=bool)
+        last_values = [None, None]
+
+        def sample(g, step_rng):
+            batched = stack_obs([self.vec_env.obs[i] for i in groups[g]])
+            return batched, self.learner.sample_actions(params, batched,
+                                                        step_rng)
+
+        cols = [slice(0, H), slice(H, B)]
+        rng, r0 = jax.random.split(rng)
+        pending = [sample(0, r0), None]
+        for t in range(T):
+            rng, r1 = jax.random.split(rng)
+            pending[1] = sample(1, r1)
+            for g in (0, 1):
+                batched, (actions, logp, values) = pending[g]
+                actions = np.asarray(actions)  # blocks on this half only
+                obs_buf[g].append(batched)
+                act_buf[t, cols[g]] = actions
+                logp_buf[t, cols[g]] = np.asarray(logp)
+                val_buf[t, cols[g]] = np.asarray(values)
+                # host steps this half while the device runs the other half's
+                # (already dispatched) sampling
+                _, rewards, dones = self.vec_env.step_subset(groups[g],
+                                                             actions)
+                rew_buf[t, cols[g]] = rewards
+                done_buf[t, cols[g]] = dones
+                if g == 0:
+                    rng, rnext = jax.random.split(rng)
+                    pending[0] = sample(0, rnext)
+                    if t + 1 == T:
+                        last_values[0] = pending[0][1][2]
+        # group 1 bootstrap: dispatched after group 0's
+        rng, rlast = jax.random.split(rng)
+        last_values[1] = sample(1, rlast)[1][2]
+
+        traj_obs = {
+            k: np.concatenate(
+                [np.stack([o[k] for o in obs_buf[0]]),
+                 np.stack([o[k] for o in obs_buf[1]])], axis=1)
+            for k in OBS_KEYS}
+        return {
+            "traj": {"obs": traj_obs, "actions": act_buf, "logp": logp_buf,
+                     "values": val_buf, "rewards": rew_buf,
+                     "dones": done_buf},
+            "last_values": np.concatenate([np.asarray(last_values[0]),
+                                           np.asarray(last_values[1])]),
             "episodes": self.vec_env.drain_completed_episodes(),
             "env_steps": T * B,
         }

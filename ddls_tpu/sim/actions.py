@@ -312,14 +312,21 @@ def assign_dep_run_times(cluster, op_partition: OpPartition,
         candidate_groups, sync_groups, o2o = group_collectives(
             original, partitioned, split_fwd)
 
-        def server_of(op_id: str) -> str:
-            return topo.worker_to_server[placement[op_id]]
+        # hot path: one lookup per op instead of two chained lookups per edge
+        # endpoint, and dict-based comm-time memoisation per topology (sync
+        # cliques price hundreds of identically-shaped 2-edge collectives)
+        worker_to_server = topo.worker_to_server
+        op_server = {op_id: worker_to_server[w]
+                     for op_id, w in placement.items()}
+        edge_size = partitioned.graph.edge_size
+        set_run_time = partitioned.set_dep_init_run_time
+        allreduce_cache = cluster.comm_time_cache
 
         collectives: List[List[EdgeId]] = list(sync_groups)
         for group in candidate_groups:
             # placement-symmetric parent/child multisets -> true collective
-            parent_servers = sorted(server_of(u) for u, _ in group)
-            child_servers = sorted(server_of(v) for _, v in group)
+            parent_servers = sorted(op_server[u] for u, _ in group)
+            child_servers = sorted(op_server[v] for _, v in group)
             if parent_servers == child_servers:
                 collectives.append(group)
             else:
@@ -329,9 +336,9 @@ def assign_dep_run_times(cluster, op_partition: OpPartition,
             servers = set()
             message_size = 0.0
             for u, v in group:
-                servers.add(server_of(u))
-                servers.add(server_of(v))
-                message_size += partitioned.graph.edge_size(u, v)
+                servers.add(op_server[u])
+                servers.add(op_server[v])
+                message_size += edge_size(u, v)
             if len(servers) == 1:
                 run_time = 0.0
             else:
@@ -341,27 +348,31 @@ def assign_dep_run_times(cluster, op_partition: OpPartition,
                     cgs.add(c)
                     racks.add(r)
                     srv_ids.add(s)
-                run_time = ramp_all_reduce_time(
-                    message_size=message_size,
-                    num_servers=len(srv_ids),
-                    num_racks=len(racks),
-                    num_comm_groups=len(cgs),
-                    network_comm_groups=topo.num_communication_groups,
-                    data_rate=topo.channel_bandwidth,
-                    propagation_latency=topo.intra_gpu_propagation_latency,
-                    io_latency=topo.worker_io_latency)
+                key = (message_size, len(srv_ids), len(racks), len(cgs))
+                run_time = allreduce_cache.get(key)
+                if run_time is None:
+                    run_time = ramp_all_reduce_time(
+                        message_size=message_size,
+                        num_servers=len(srv_ids),
+                        num_racks=len(racks),
+                        num_comm_groups=len(cgs),
+                        network_comm_groups=topo.num_communication_groups,
+                        data_rate=topo.channel_bandwidth,
+                        propagation_latency=topo.intra_gpu_propagation_latency,
+                        io_latency=topo.worker_io_latency)
+                    allreduce_cache[key] = run_time
             for dep in group:
-                partitioned.set_dep_init_run_time(dep, run_time)
+                set_run_time(dep, run_time)
 
         for (u, v) in o2o:
-            if server_of(u) == server_of(v):
+            if op_server[u] == op_server[v]:
                 run_time = 0.0
-            elif partitioned.graph.edge_size(u, v) == 0:
+            elif edge_size(u, v) == 0:
                 run_time = 0.0
             else:
                 run_time = one_to_one_time(
-                    partitioned.graph.edge_size(u, v),
+                    edge_size(u, v),
                     data_rate=topo.channel_bandwidth,
                     propagation_latency=topo.intra_gpu_propagation_latency,
                     io_latency=topo.worker_io_latency)
-            partitioned.set_dep_init_run_time((u, v), run_time)
+            set_run_time((u, v), run_time)

@@ -126,6 +126,9 @@ class RampClusterEnvironment:
         # (reference warns about the same constraint, :269-277)
         self.partition_cache: Dict[Tuple[str, int], dict] = {}
         self.lookahead_cache: Dict[Tuple[str, int], tuple] = {}
+        # all-reduce pricing memo keyed by (message_size, servers, racks,
+        # comm groups); topology params are fixed for the cluster's lifetime
+        self.comm_time_cache: Dict[tuple, float] = {}
 
         self.steps_log = defaultdict(list)
         self.episode_stats = self._init_episode_stats()

@@ -20,8 +20,9 @@ class Checkpointer:
         self.epoch_checkpoint_freq = epoch_checkpoint_freq
 
     def should_checkpoint(self, epoch_counter: int) -> bool:
-        return (self.epoch_checkpoint_freq is not None
-                and epoch_counter % self.epoch_checkpoint_freq == 0)
+        freq = self.epoch_checkpoint_freq
+        # 0/None uniformly mean "never checkpoint"
+        return bool(freq) and freq > 0 and epoch_counter % freq == 0
 
     def write(self, epoch_loop, epoch_counter: int) -> str:
         path = self.checkpoints_dir / f"checkpoint_{epoch_counter:06d}"

@@ -79,9 +79,12 @@ class Launcher:
                 last_results = results
 
                 if logger is not None:
+                    # accumulate every epoch; epoch_log_freq gates only the
+                    # disk write (reference launcher.py:118 accumulates
+                    # unconditionally too)
+                    logger.log({"epochs": [self._scalarise(results)]})
                     freq = getattr(logger, "epoch_log_freq", 1) or 1
                     if self.epoch_counter % freq == 0:
-                        logger.log({"epochs": [self._scalarise(results)]})
                         logger.save()
                 self.epoch_loop.log(results)
 

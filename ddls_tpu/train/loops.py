@@ -22,7 +22,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ddls_tpu.utils.common import get_class_from_path, seed_everything
+from ddls_tpu.utils.common import (available_cores, get_class_from_path,
+                                   seed_everything)
 
 # RLlib PPO keys (algo/ppo.yaml) -> PPOConfig fields
 _RLLIB_TO_PPO = {
@@ -121,7 +122,7 @@ class RLEpochLoop:
                  num_envs: Optional[int] = None,
                  rollout_length: Optional[int] = None,
                  n_devices: Optional[int] = None,
-                 use_parallel_envs: bool = False,
+                 use_parallel_envs="auto",
                  metric: str = "evaluation/episode_reward_mean",
                  metric_goal: str = "maximise",
                  evaluation_interval: Optional[int] = 1,
@@ -158,6 +159,9 @@ class RLEpochLoop:
             or max(self.ppo_cfg.train_batch_size // self.num_envs, 1))
 
         seed_everything(self.seed)
+        if use_parallel_envs == "auto":
+            # subprocess env workers only pay off with real cores to run on
+            use_parallel_envs = available_cores() > 1
         if use_parallel_envs:
             self.vec_env = ParallelVectorEnv(
                 self.env_cls, self.env_config, self.num_envs,

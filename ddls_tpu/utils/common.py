@@ -31,6 +31,16 @@ class Stopwatch:
         return self._time
 
 
+def available_cores() -> int:
+    """CPU cores this process may use (affinity-aware where supported)."""
+    import os
+
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        return os.cpu_count() or 1
+
+
 def seed_everything(seed: int) -> None:
     """Seed numpy + stdlib random.
 
