@@ -2,11 +2,14 @@ from ddls_tpu.train.checkpointer import (Checkpointer, restore_train_state,
                                          save_train_state)
 from ddls_tpu.train.launcher import Launcher
 from ddls_tpu.train.logger import Logger, SqliteDict
-from ddls_tpu.train.loops import (EnvLoop, EpochLoop, EvalLoop, RLEpochLoop,
-                                  RLEvalLoop, build_policy_from_model_config,
+from ddls_tpu.train.loops import (ApexDQNEpochLoop, EnvLoop, EpochLoop,
+                                  EvalLoop, RLEpochLoop, RLEvalLoop,
+                                  build_policy_from_model_config,
+                                  dqn_config_from_rllib, make_epoch_loop,
                                   ppo_config_from_rllib)
 
 __all__ = ["Checkpointer", "restore_train_state", "save_train_state",
-           "Launcher", "Logger", "SqliteDict", "EnvLoop", "EpochLoop",
-           "EvalLoop", "RLEpochLoop", "RLEvalLoop",
-           "build_policy_from_model_config", "ppo_config_from_rllib"]
+           "Launcher", "Logger", "SqliteDict", "ApexDQNEpochLoop", "EnvLoop",
+           "EpochLoop", "EvalLoop", "RLEpochLoop", "RLEvalLoop",
+           "build_policy_from_model_config", "dqn_config_from_rllib",
+           "make_epoch_loop", "ppo_config_from_rllib"]

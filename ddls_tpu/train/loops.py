@@ -55,6 +55,46 @@ def ppo_config_from_rllib(algo_config: Optional[dict]):
     return PPOConfig(**kwargs)
 
 
+# RLlib Ape-X DQN keys (algo/apex_dqn.yaml) -> DQNConfig fields; nested
+# replay_buffer_config / exploration_config keys are flattened first
+_RLLIB_TO_DQN = {
+    "lr": "lr",
+    "gamma": "gamma",
+    "n_step": "n_step",
+    "train_batch_size": "train_batch_size",
+    "target_network_update_freq": "target_network_update_freq",
+    "double_q": "double_q",
+    "dueling": "dueling",
+    "num_atoms": "num_atoms",
+    "grad_clip": "grad_clip",
+    "training_intensity": "training_intensity",
+    "capacity": "buffer_capacity",
+    "prioritized_replay_alpha": "prioritized_replay_alpha",
+    "prioritized_replay_beta": "prioritized_replay_beta",
+    "prioritized_replay_eps": "prioritized_replay_eps",
+    "learning_starts": "learning_starts",
+    "initial_epsilon": "initial_epsilon",
+    "final_epsilon": "final_epsilon",
+    "epsilon_timesteps": "epsilon_timesteps",
+}
+
+
+def dqn_config_from_rllib(algo_config: Optional[dict]):
+    """Translate an RLlib-style Ape-X DQN config dict into a ``DQNConfig``
+    (reference surface: scripts/ramp_job_partitioning_configs/algo/
+    apex_dqn.yaml; Ray-plumbing keys are ignored)."""
+    from ddls_tpu.rl.dqn import DQNConfig
+
+    flat = dict(algo_config or {})
+    for nested in ("replay_buffer_config", "exploration_config"):
+        flat.update(flat.pop(nested, None) or {})
+    kwargs = {}
+    for src, dst in _RLLIB_TO_DQN.items():
+        if flat.get(src) is not None:
+            kwargs[dst] = flat[src]
+    return DQNConfig(**kwargs)
+
+
 def build_policy_from_model_config(n_actions: int,
                                    model_config: Optional[dict]):
     """Build a ``GNNPolicy`` from the reference's model/gnn.yaml surface."""
@@ -136,9 +176,7 @@ class RLEpochLoop:
         import jax
 
         from ddls_tpu.parallel.mesh import make_mesh
-        from ddls_tpu.rl.ppo import PPOLearner
-        from ddls_tpu.rl.rollout import (ParallelVectorEnv, RolloutCollector,
-                                         VectorEnv)
+        from ddls_tpu.rl.rollout import ParallelVectorEnv, VectorEnv
 
         self.env_cls = get_class_from_path(path_to_env_cls)
         self.env_config = dict(env_config)
@@ -151,12 +189,7 @@ class RLEpochLoop:
         self.seed = 0 if seed is None else int(seed)
         self.test_seed = test_seed
 
-        self.ppo_cfg = ppo_config_from_rllib(algo_config)
-        self.num_envs = int(num_envs
-                            or (algo_config or {}).get("num_workers") or 8)
-        self.rollout_length = int(
-            rollout_length
-            or max(self.ppo_cfg.train_batch_size // self.num_envs, 1))
+        self._configure_algo(algo_config, num_envs, rollout_length)
 
         seed_everything(self.seed)
         if use_parallel_envs == "auto":
@@ -180,20 +213,15 @@ class RLEpochLoop:
             n_actions = int(np.asarray(
                 self.vec_env.obs[0]["action_mask"]).shape[0])
         self.n_actions = n_actions
-        self.model = build_policy_from_model_config(n_actions, model)
+        self.model = self._build_model(n_actions, model)
 
         obs0 = jax.tree_util.tree_map(np.asarray, self.vec_env.obs[0])
         self.params = self.model.init(jax.random.PRNGKey(self.seed), obs0)
 
         from ddls_tpu.models.policy import batched_policy_apply
         self.mesh = make_mesh(n_devices)
-        self.learner = PPOLearner(
-            lambda p, o: batched_policy_apply(self.model, p, o),
-            self.ppo_cfg, self.mesh)
-        self.state = self.learner.init_state(self.params)
-        self.collector = RolloutCollector(self.vec_env, self.learner,
-                                          self.rollout_length)
-        self.collector._needs_reset = False  # already reset above
+        self.apply_fn = lambda p, o: batched_policy_apply(self.model, p, o)
+        self._build_learner()
 
         self._rng = jax.random.PRNGKey(self.seed + 1)
         self.epoch_counter = 0
@@ -202,6 +230,29 @@ class RLEpochLoop:
         self.best_checkpoint_path: Optional[str] = None
         self.checkpoint_history: List[dict] = []
         self.run_time = 0.0
+
+    # ------------------------------------------------------------ algo hooks
+    def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
+        """Translate the RLlib-style algo_config; PPO by default."""
+        self.ppo_cfg = ppo_config_from_rllib(algo_config)
+        self.num_envs = int(num_envs
+                            or (algo_config or {}).get("num_workers") or 8)
+        self.rollout_length = int(
+            rollout_length
+            or max(self.ppo_cfg.train_batch_size // self.num_envs, 1))
+
+    def _build_model(self, n_actions: int, model_config):
+        return build_policy_from_model_config(n_actions, model_config)
+
+    def _build_learner(self) -> None:
+        from ddls_tpu.rl.ppo import PPOLearner
+        from ddls_tpu.rl.rollout import RolloutCollector
+
+        self.learner = PPOLearner(self.apply_fn, self.ppo_cfg, self.mesh)
+        self.state = self.learner.init_state(self.params)
+        self.collector = RolloutCollector(self.vec_env, self.learner,
+                                          self.rollout_length)
+        self.collector._needs_reset = False  # env already reset in __init__
 
     # ----------------------------------------------------------------- epoch
     def _split_rng(self):
@@ -229,8 +280,14 @@ class RLEpochLoop:
             "total_env_steps": self.total_env_steps,
             "learner": metrics,
         }
-        results.update(_episode_summary(out["episodes"]))
-        results["episodes"] = out["episodes"]
+        return self._finalize_results(results, out["episodes"], start)
+
+    def _finalize_results(self, results: Dict[str, Any],
+                          episodes: List[dict], start: float) -> Dict[str, Any]:
+        """Shared epoch epilogue: episode summary, periodic evaluation,
+        timing bookkeeping."""
+        results.update(_episode_summary(episodes))
+        results["episodes"] = episodes
 
         if (self.evaluation_interval
                 and self.epoch_counter % self.evaluation_interval == 0):
@@ -295,12 +352,18 @@ class RLEpochLoop:
         while not done:
             batched = jax.tree_util.tree_map(
                 lambda x: np.asarray(x)[None], obs)
-            logits, _ = self.learner.apply_fn(self.state.params, batched)
-            action = int(np.asarray(jax.device_get(logits))[0].argmax())
-            obs, reward, done, _ = env.step(action)
+            obs, reward, done, _ = env.step(self._greedy_action(batched))
             total += reward
             steps += 1
         return harvest_episode_record(env, 0, total, steps)
+
+    def _greedy_action(self, batched_obs) -> int:
+        """Greedy action for a [1, ...] obs batch; PPO: argmax of the
+        (mask-adjusted) policy logits."""
+        import jax
+
+        logits, _ = self.learner.apply_fn(self.state.params, batched_obs)
+        return int(np.asarray(jax.device_get(logits))[0].argmax())
 
     # ----------------------------------------------------------- checkpoints
     def save_agent_checkpoint(self, path: str) -> str:
@@ -373,6 +436,142 @@ class RLEpochLoop:
 
     def close(self) -> None:
         self.vec_env.close()
+
+
+class ApexDQNEpochLoop(RLEpochLoop):
+    """Ape-X DQN epoch loop: vectorised epsilon-greedy collection into a
+    prioritised replay buffer + jitted double/dueling DQN updates on the
+    mesh (reference trains the same env through RLlib's ApexTrainer,
+    algo/apex_dqn.yaml; see ddls_tpu.rl.dqn for the TPU-native redesign)."""
+
+    def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
+        self.dqn_cfg = dqn_config_from_rllib(algo_config)
+        self.num_envs = int(num_envs
+                            or (algo_config or {}).get("num_workers") or 8)
+        # per epoch, collect about one train batch worth of transitions
+        self.rollout_length = int(
+            rollout_length
+            or max(self.dqn_cfg.train_batch_size // self.num_envs, 1))
+
+    def _build_model(self, n_actions: int, model_config):
+        import copy
+
+        # Q-net logits must stay finite for the dueling mean; invalid
+        # actions are masked at selection instead (dqn.py module docstring)
+        model_config = copy.deepcopy(model_config or {})
+        model_config.setdefault("custom_model_config", {})[
+            "apply_action_mask"] = False
+        return build_policy_from_model_config(n_actions, model_config)
+
+    def _build_learner(self) -> None:
+        from ddls_tpu.rl.dqn import ApexDQNLearner, PrioritizedReplayBuffer
+
+        cfg = self.dqn_cfg
+        self.learner = ApexDQNLearner(self.apply_fn, cfg, self.mesh)
+        self.state = self.learner.init_state(self.params)
+        self.replay = PrioritizedReplayBuffer(
+            cfg.buffer_capacity, cfg.prioritized_replay_alpha,
+            cfg.prioritized_replay_beta, cfg.prioritized_replay_eps,
+            seed=self.seed)
+        self._nstep_queues: List[List[dict]] = [
+            [] for _ in range(self.num_envs)]
+
+    def run(self) -> Dict[str, Any]:
+        """Collect rollout_length epsilon-greedy steps per env into replay,
+        then apply ``training_intensity``-matched DQN updates."""
+        import jax
+
+        from ddls_tpu.rl.dqn import nstep_transitions, per_worker_epsilons
+        from ddls_tpu.rl.rollout import stack_obs
+
+        cfg = self.dqn_cfg
+        start = time.time()
+        T, B = self.rollout_length, self.num_envs
+
+        for _ in range(T):
+            batched = stack_obs(self.vec_env.obs)
+            eps = per_worker_epsilons(B, self.total_env_steps, cfg)
+            actions = np.asarray(self.learner.sample_actions(
+                self.state.params, batched, self._split_rng(), eps))
+            prev_obs = list(self.vec_env.obs)
+            _, rewards, dones = self.vec_env.step(actions)
+            for i in range(B):
+                queue = self._nstep_queues[i]
+                queue.append({
+                    "obs": prev_obs[i], "action": int(actions[i]),
+                    "reward": float(rewards[i]), "done": bool(dones[i]),
+                    # at episode end this is the auto-reset obs, but then
+                    # discount == 0 so the target never reads it
+                    "next_obs": self.vec_env.obs[i]})
+                for tr in nstep_transitions(queue, cfg.n_step, cfg.gamma,
+                                            flush=bool(dones[i])):
+                    self.replay.add(tr)
+            self.total_env_steps += B
+
+        env_steps = T * B
+        metrics_acc: List[Dict[str, float]] = []
+        # learning_starts counts cumulative sampled transitions (as RLlib
+        # does), NOT current buffer occupancy — a capacity smaller than
+        # learning_starts must still start training once enough steps were
+        # sampled
+        if (self.total_env_steps >= cfg.learning_starts
+                and self.replay.size >= cfg.train_batch_size):
+            num_updates = max(1, int(round(
+                env_steps * cfg.training_intensity / cfg.train_batch_size)))
+            for _ in range(num_updates):
+                batch, idx, weights = self.replay.sample(
+                    cfg.train_batch_size)
+                tbatch = {"obs": batch["obs"],
+                          "actions": batch["action"],
+                          "rewards": batch["reward"],
+                          "next_obs": batch["next_obs"],
+                          "discounts": batch["discount"],
+                          "weights": weights}
+                self.state, metrics, td = self.learner.train_step(
+                    self.state, tbatch)
+                self.replay.update_priorities(idx, td)
+                metrics_acc.append({k: float(v) for k, v in
+                                    jax.device_get(metrics).items()})
+
+        self.epoch_counter += 1
+        learner_metrics = ({k: float(np.mean([m[k] for m in metrics_acc]))
+                            for k in metrics_acc[0]} if metrics_acc else {})
+        learner_metrics["num_updates"] = len(metrics_acc)
+        learner_metrics["replay_size"] = self.replay.size
+        results: Dict[str, Any] = {
+            "epoch_counter": self.epoch_counter,
+            "env_steps_this_iter": env_steps,
+            "total_env_steps": self.total_env_steps,
+            "learner": learner_metrics,
+        }
+        return self._finalize_results(
+            results, self.vec_env.drain_completed_episodes(), start)
+
+    def _greedy_action(self, batched_obs) -> int:
+        import jax
+
+        actions = self.learner.sample_actions(
+            self.state.params, batched_obs, jax.random.PRNGKey(0),
+            np.zeros(1, np.float32))
+        return int(np.asarray(actions)[0])
+
+
+# algo_name (our algo/*.yaml) -> epoch-loop class; train_from_config
+# dispatches through this and hard-errors on unknown names so a mistyped
+# algo can never silently train PPO-with-defaults
+EPOCH_LOOPS = {
+    "ppo": RLEpochLoop,
+    "apex_dqn": ApexDQNEpochLoop,
+}
+
+
+def make_epoch_loop(algo_name: Optional[str], **kwargs):
+    name = (algo_name or "ppo").lower()
+    if name not in EPOCH_LOOPS:
+        raise ValueError(
+            f"unknown algo_name {algo_name!r}; available: "
+            f"{sorted(EPOCH_LOOPS)}")
+    return EPOCH_LOOPS[name](**kwargs)
 
 
 class EvalLoop:

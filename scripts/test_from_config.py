@@ -15,7 +15,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddls_tpu.config import load_config, save_config
-from ddls_tpu.train import Logger, RLEpochLoop, RLEvalLoop
+from ddls_tpu.train import Logger, RLEvalLoop, make_epoch_loop
 from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
 from train_from_config import build_epoch_loop_kwargs
 
@@ -54,7 +54,8 @@ def main(argv=None) -> int:
     kwargs["num_envs"] = 1
     kwargs["rollout_length"] = 1
     kwargs["evaluation_interval"] = None
-    epoch_loop = RLEpochLoop(**kwargs)
+    algo_name = (cfg.get("algo") or {}).get("algo_name", "ppo")
+    epoch_loop = make_epoch_loop(algo_name, **kwargs)
     eval_loop = RLEvalLoop(epoch_loop)
 
     all_results = []

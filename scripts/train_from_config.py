@@ -23,7 +23,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddls_tpu.config import load_config, save_config
-from ddls_tpu.train import Checkpointer, Launcher, Logger, RLEpochLoop
+from ddls_tpu.train import Checkpointer, Launcher, Logger, make_epoch_loop
 from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
 
 
@@ -91,8 +91,11 @@ def main(argv=None) -> int:
         except ImportError:
             print("wandb requested but not installed; continuing without it")
 
-    epoch_loop = RLEpochLoop(wandb=wandb, **build_epoch_loop_kwargs(cfg))
-    print(f"Initialised RLEpochLoop: {epoch_loop.num_envs} envs x "
+    algo_name = (cfg.get("algo") or {}).get("algo_name", "ppo")
+    epoch_loop = make_epoch_loop(algo_name, wandb=wandb,
+                                 **build_epoch_loop_kwargs(cfg))
+    print(f"Initialised {type(epoch_loop).__name__} ({algo_name}): "
+          f"{epoch_loop.num_envs} envs x "
           f"{epoch_loop.rollout_length} steps on mesh "
           f"{dict(epoch_loop.mesh.shape)}")
 
