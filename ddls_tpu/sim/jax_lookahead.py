@@ -1,0 +1,287 @@
+"""Jittable (vmappable) lookahead tick engine over fixed-size padded arrays.
+
+The north-star prototype (SURVEY.md §3.5, §7.4.2): the host engine
+(``cluster._run_lookahead``) simulates one training step of a mounted job by
+dependency-driven ticking; this module reproduces those exact semantics as a
+``lax.while_loop`` over padded arrays so the lookahead can run inside jit —
+one step toward HBM-resident environment rollouts — and be vmapped over a
+batch of jobs.
+
+Semantics mirrored from the host engine (cluster.py ``_run_lookahead``):
+
+* per worker, the highest-priority *ready* op is selected (ties break to the
+  smallest op id in sorted order); the op bound is the shortest remaining
+  time among selected ops;
+* ready non-flow deps (zero size or same server) complete at zero cost, and
+  any such dep forces a zero tick (host: ``shortest_comm = 0.0``);
+* otherwise each channel nominates its highest-priority ready flow dep and
+  the comm bound is the shortest remaining among nominated deps, while ALL
+  ready flow deps tick in parallel (the reference's documented
+  parallel-flow-tick hack, ramp_cluster_environment.py:756);
+* deps readied by op completions within a tick do not advance until the next
+  tick (the host snapshots ready deps before op ticking);
+* mutual (backward-sync) deps never gate their destination op's readiness;
+* comm/comp overhead accumulate per tick according to whether ops and/or
+  flow deps advanced.
+
+Priorities are combined with sorted-id ranks into a single score so argmax
+reproduces the host's deterministic tie-breaking. All arrays are padded to
+static shapes; invalid slots carry ``valid=False`` masks.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import numpy as np
+
+BIG = np.float32(3.4e38)  # stands in for +inf inside the kernel
+
+
+@dataclasses.dataclass
+class LookaheadArrays:
+    """Padded single-job lookahead inputs (all numpy, ready for device).
+
+    Shapes: N = padded ops, E = padded deps, L = max channels per flow dep.
+    ``op_score``/``dep_score`` are priority-with-rank combined scores
+    (higher wins; distinct per valid slot). ``dep_channel`` holds channel
+    indices (-1 padding) into a dense per-job channel renumbering.
+    """
+    op_remaining: np.ndarray   # [N] f32
+    op_valid: np.ndarray       # [N] bool
+    op_worker: np.ndarray      # [N] i32 (dense worker index, -1 pad)
+    op_score: np.ndarray       # [N] f32
+    num_parents: np.ndarray    # [N] i32 (non-mutual parent deps)
+    dep_remaining: np.ndarray  # [E] f32
+    dep_valid: np.ndarray      # [E] bool
+    dep_src: np.ndarray        # [E] i32
+    dep_dst: np.ndarray        # [E] i32
+    dep_mutual: np.ndarray     # [E] bool
+    dep_is_flow: np.ndarray    # [E] bool
+    dep_score: np.ndarray      # [E] f32
+    dep_channel: np.ndarray    # [E, L] i32 (-1 pad)
+    num_workers: int           # static
+    num_channels: int          # static
+
+
+def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
+                           pad_links: int = 1) -> LookaheadArrays:
+    """Assemble padded arrays for a job already mounted on the cluster
+    (the same inputs the host engine reads)."""
+    job_idx = job.details["job_idx"]
+    graph = job.graph
+    arrays = graph.finalize()
+    n, m = graph.n_ops, graph.n_deps
+    if n > pad_ops or m > pad_deps:
+        raise ValueError(f"job needs ({n},{m}) > padding ({pad_ops},{pad_deps})")
+
+    topo = cluster.topology
+    # dense per-job worker renumbering (only workers holding this job matter)
+    worker_ids = sorted({cluster.job_op_to_worker[(job_idx, op)]
+                         for op in graph.op_ids})
+    worker_dense = {w: i for i, w in enumerate(worker_ids)}
+
+    op_remaining = np.zeros(pad_ops, np.float32)
+    op_remaining[:n] = arrays["compute"]
+    op_valid = np.zeros(pad_ops, bool)
+    op_valid[:n] = True
+    op_worker = np.full(pad_ops, -1, np.int32)
+    op_score = np.zeros(pad_ops, np.float32)
+    num_parents = np.zeros(pad_ops, np.int32)
+    num_parents[:n] = arrays["num_parents"]
+
+    # host tie-break: first op in sorted-id order among priority maxes
+    sorted_rank = {op: r for r, op in enumerate(sorted(graph.op_ids))}
+    for op_id in graph.op_ids:
+        i = arrays["op_index"][op_id]
+        w = cluster.job_op_to_worker[(job_idx, op_id)]
+        op_worker[i] = worker_dense[w]
+        pri = topo.workers[w].op_priority.get((job_idx, op_id), 0)
+        op_score[i] = pri * (n + 1) + (n - sorted_rank[op_id])
+
+    dep_remaining = np.zeros(pad_deps, np.float32)
+    dep_valid = np.zeros(pad_deps, bool)
+    dep_valid[:m] = True
+    dep_src = np.zeros(pad_deps, np.int32)
+    dep_dst = np.zeros(pad_deps, np.int32)
+    dep_mutual = np.zeros(pad_deps, bool)
+    dep_mutual[:m] = arrays["edge_mutual"]
+    dep_is_flow = np.zeros(pad_deps, bool)
+    dep_score = np.zeros(pad_deps, np.float32)
+    dep_channel = np.full((pad_deps, pad_links), -1, np.int32)
+
+    # dense per-job channel renumbering
+    chan_dense: Dict[str, int] = {}
+    dep_sorted_rank = {e: r for r, e in enumerate(sorted(graph.edge_ids))}
+    worker_to_server = topo.worker_to_server
+    for edge in graph.edge_ids:
+        ei = arrays["edge_index"][edge]
+        u, v = edge
+        dep_src[ei] = arrays["op_index"][u]
+        dep_dst[ei] = arrays["op_index"][v]
+        dep_remaining[ei] = job.dep_init_run_time.get(edge, 0.0)
+        src_w = cluster.job_op_to_worker[(job_idx, u)]
+        dst_w = cluster.job_op_to_worker[(job_idx, v)]
+        is_flow = (graph.edge_size(u, v) > 0
+                   and worker_to_server[src_w] != worker_to_server[dst_w])
+        dep_is_flow[ei] = is_flow
+        if is_flow:
+            channels = sorted(cluster.job_dep_to_channels.get(
+                (job_idx, edge), ()))
+            if len(channels) > pad_links:
+                raise ValueError(
+                    f"dep {edge} rides {len(channels)} channels > pad_links "
+                    f"{pad_links}")
+            for li, ch_id in enumerate(channels):
+                dep_channel[ei, li] = chan_dense.setdefault(
+                    ch_id, len(chan_dense))
+            ch = (topo.channel_id_to_channel[channels[0]]
+                  if channels else None)
+            pri = (ch.dep_priority.get((job_idx, edge), 0)
+                   if ch is not None else 0)
+        else:
+            pri = 0
+        dep_score[ei] = pri * (m + 1) + (m - dep_sorted_rank[edge])
+
+    return LookaheadArrays(
+        op_remaining=op_remaining, op_valid=op_valid, op_worker=op_worker,
+        op_score=op_score, num_parents=num_parents,
+        dep_remaining=dep_remaining, dep_valid=dep_valid, dep_src=dep_src,
+        dep_dst=dep_dst, dep_mutual=dep_mutual, dep_is_flow=dep_is_flow,
+        dep_score=dep_score, dep_channel=dep_channel,
+        num_workers=max(len(worker_dense), 1),
+        num_channels=max(len(chan_dense), 1))
+
+
+def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
+                  dep_remaining, dep_valid, dep_src, dep_dst, dep_mutual,
+                  dep_is_flow, dep_score, dep_channel,
+                  *, num_workers: int, num_channels: int):
+    """One-training-step lookahead; returns (t, comm_oh, comp_oh, ok).
+
+    Pure function of arrays — jit/vmap-friendly. ``ok`` is False when the
+    engine could not progress (the host raises in that case).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    N = op_remaining.shape[0]
+    E = dep_remaining.shape[0]
+    max_iters = N + E + 4
+
+    worker_onehot = (jax.nn.one_hot(op_worker, num_workers, dtype=jnp.float32)
+                     .T)  # [W, N]; -1 (padding) one-hots to zeros
+
+    def cond(state):
+        (_, _, op_done, dep_done, _, _, _, _, it, stuck) = state
+        all_done = (jnp.all(op_done | ~op_valid)
+                    & jnp.all(dep_done | ~dep_valid))
+        return (~all_done) & (it < max_iters) & (~stuck)
+
+    def body(state):
+        (rem_op, rem_dep, op_done, dep_done, parent_done,
+         t, comm_oh, comp_oh, it, stuck) = state
+
+        # 1. readiness (snapshotted BEFORE this tick's completions)
+        ops_ready = op_valid & ~op_done & (parent_done >= num_parents)
+        deps_ready = dep_valid & ~dep_done & op_done[dep_src]
+        flow_ready = deps_ready & dep_is_flow
+        nonflow_ready = deps_ready & ~dep_is_flow
+        any_nonflow = jnp.any(nonflow_ready)
+
+        # 2. per-worker highest-score ready op
+        scores = jnp.where(ops_ready, op_score, -1.0)
+        per_worker = worker_onehot * scores[None, :]  # [W, N]
+        best_score = per_worker.max(axis=1)           # [W]
+        has_op = best_score > 0
+        # an op is selected iff it is its worker's best ready op
+        sel_ops = ops_ready & jnp.any(
+            (per_worker == best_score[:, None]) & (best_score[:, None] > 0)
+            & (worker_onehot > 0), axis=0)
+        shortest_op = jnp.min(jnp.where(sel_ops, rem_op, BIG))
+
+        # 3. per-channel highest-score ready flow dep (scatter-max)
+        dscores = jnp.where(flow_ready, dep_score, -1.0)
+        ch_best = jnp.full((num_channels,), -1.0)
+        for li in range(dep_channel.shape[1]):
+            ch_idx = dep_channel[:, li]
+            contrib = jnp.where(ch_idx >= 0, dscores, -1.0)
+            ch_best = ch_best.at[jnp.clip(ch_idx, 0)].max(contrib)
+        # dep nominated iff it is the best on at least one of its channels
+        nominated = jnp.zeros((E,), bool)
+        for li in range(dep_channel.shape[1]):
+            ch_idx = dep_channel[:, li]
+            nominated = nominated | (
+                (ch_idx >= 0) & flow_ready
+                & (dscores >= ch_best[jnp.clip(ch_idx, 0)]) & (dscores > 0))
+        shortest_comm = jnp.where(
+            any_nonflow, 0.0,
+            jnp.min(jnp.where(nominated, rem_dep, BIG)))
+
+        tick = jnp.minimum(shortest_op, shortest_comm)
+        new_stuck = tick >= BIG  # nothing can progress: host raises
+
+        # 4. advance ops
+        rem_op2 = jnp.where(sel_ops, jnp.maximum(rem_op - tick, 0.0), rem_op)
+        op_now_done = sel_ops & (rem_op2 <= 0.0) & ~op_done
+        op_done2 = op_done | op_now_done
+
+        # 5. advance deps: the snapshot's non-flow deps if any, else ALL
+        # snapshot-ready flow deps (parallel-flow hack)
+        dep_tick_mask = jnp.where(any_nonflow, nonflow_ready, flow_ready)
+        rem_dep2 = jnp.where(dep_tick_mask,
+                             jnp.maximum(rem_dep - tick, 0.0), rem_dep)
+        dep_now_done = dep_tick_mask & (rem_dep2 <= 0.0) & ~dep_done
+        dep_done2 = dep_done | dep_now_done
+
+        # 6. non-mutual completed deps advance their child's parent count
+        inc = (dep_now_done & ~dep_mutual).astype(jnp.int32)
+        parent_done2 = parent_done.at[dep_dst].add(inc)
+
+        ticked_ops = jnp.any(sel_ops)
+        ticked_flows = (~any_nonflow) & jnp.any(flow_ready)
+        safe_tick = jnp.where(new_stuck, 0.0, tick)
+        comp_oh2 = comp_oh + jnp.where(ticked_ops, safe_tick, 0.0)
+        comm_oh2 = comm_oh + jnp.where(ticked_flows, safe_tick, 0.0)
+        t2 = t + safe_tick
+
+        return (rem_op2, rem_dep2, op_done2, dep_done2, parent_done2,
+                t2, comm_oh2, comp_oh2, it + 1, stuck | new_stuck)
+
+    init = (op_remaining, dep_remaining,
+            jnp.zeros((N,), bool), jnp.zeros((E,), bool),
+            jnp.zeros((N,), jnp.int32),
+            jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
+            jnp.int32(0), jnp.bool_(False))
+    out = jax.lax.while_loop(cond, body, init)
+    (_, _, op_done, dep_done, _, t, comm_oh, comp_oh, it, stuck) = out
+    finished = (jnp.all(op_done | ~op_valid)
+                & jnp.all(dep_done | ~dep_valid))
+    return t, comm_oh, comp_oh, finished & ~stuck
+
+
+def lookahead_fn(num_workers: int, num_channels: int, pad_links: int = 1):
+    """Jitted single-job lookahead closure over static sizes."""
+    import jax
+    from functools import partial
+
+    return jax.jit(partial(jax_lookahead, num_workers=num_workers,
+                           num_channels=num_channels))
+
+
+def batched_lookahead_fn(num_workers: int, num_channels: int):
+    """vmapped+jitted lookahead over a batch of padded jobs (leading batch
+    axis on every array input)."""
+    import jax
+    from functools import partial
+
+    fn = partial(jax_lookahead, num_workers=num_workers,
+                 num_channels=num_channels)
+    return jax.jit(jax.vmap(fn))
+
+
+def arrays_as_args(a: LookaheadArrays) -> Tuple[np.ndarray, ...]:
+    return (a.op_remaining, a.op_valid, a.op_worker, a.op_score,
+            a.num_parents, a.dep_remaining, a.dep_valid, a.dep_src,
+            a.dep_dst, a.dep_mutual, a.dep_is_flow, a.dep_score,
+            a.dep_channel)

@@ -232,14 +232,20 @@ class RLEpochLoop:
         self.run_time = 0.0
 
     # ------------------------------------------------------------ algo hooks
-    def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
-        """Translate the RLlib-style algo_config; PPO by default."""
-        self.ppo_cfg = ppo_config_from_rllib(algo_config)
+    def _size_rollouts(self, algo_config, num_envs, rollout_length,
+                       train_batch_size: int) -> None:
+        """num_envs from config (reference: num_workers), rollout length
+        sized so one epoch collects about one train batch."""
         self.num_envs = int(num_envs
                             or (algo_config or {}).get("num_workers") or 8)
         self.rollout_length = int(
-            rollout_length
-            or max(self.ppo_cfg.train_batch_size // self.num_envs, 1))
+            rollout_length or max(train_batch_size // self.num_envs, 1))
+
+    def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
+        """Translate the RLlib-style algo_config; PPO by default."""
+        self.ppo_cfg = ppo_config_from_rllib(algo_config)
+        self._size_rollouts(algo_config, num_envs, rollout_length,
+                            self.ppo_cfg.train_batch_size)
 
     def _build_model(self, n_actions: int, model_config):
         return build_policy_from_model_config(n_actions, model_config)
@@ -446,12 +452,8 @@ class ApexDQNEpochLoop(RLEpochLoop):
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.dqn_cfg = dqn_config_from_rllib(algo_config)
-        self.num_envs = int(num_envs
-                            or (algo_config or {}).get("num_workers") or 8)
-        # per epoch, collect about one train batch worth of transitions
-        self.rollout_length = int(
-            rollout_length
-            or max(self.dqn_cfg.train_batch_size // self.num_envs, 1))
+        self._size_rollouts(algo_config, num_envs, rollout_length,
+                            self.dqn_cfg.train_batch_size)
 
     def _build_model(self, n_actions: int, model_config):
         import copy
@@ -482,7 +484,12 @@ class ApexDQNEpochLoop(RLEpochLoop):
         import jax
 
         from ddls_tpu.rl.dqn import nstep_transitions, per_worker_epsilons
-        from ddls_tpu.rl.rollout import stack_obs
+        from ddls_tpu.rl.rollout import OBS_KEYS, stack_obs
+
+        def slim(obs):
+            # keep only network-consumed keys (drops e.g. the constant
+            # action_set) so replay storage matches the acting pytree
+            return {k: obs[k] for k in OBS_KEYS}
 
         cfg = self.dqn_cfg
         start = time.time()
@@ -498,11 +505,11 @@ class ApexDQNEpochLoop(RLEpochLoop):
             for i in range(B):
                 queue = self._nstep_queues[i]
                 queue.append({
-                    "obs": prev_obs[i], "action": int(actions[i]),
+                    "obs": slim(prev_obs[i]), "action": int(actions[i]),
                     "reward": float(rewards[i]), "done": bool(dones[i]),
                     # at episode end this is the auto-reset obs, but then
                     # discount == 0 so the target never reads it
-                    "next_obs": self.vec_env.obs[i]})
+                    "next_obs": slim(self.vec_env.obs[i])})
                 for tr in nstep_transitions(queue, cfg.n_step, cfg.gamma,
                                             flush=bool(dones[i])):
                     self.replay.add(tr)

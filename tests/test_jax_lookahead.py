@@ -1,0 +1,113 @@
+"""The jitted array lookahead must reproduce the host tick engine's
+JCT/overhead outputs on real mounted jobs (SURVEY.md §7.4.1: build the
+host oracle first, then property-test the array engine against it)."""
+import numpy as np
+import pytest
+
+from ddls_tpu.envs.partitioning_env import RampJobPartitioningEnvironment
+
+
+def _make_env(dataset_dir, max_partitions=4):
+    return RampJobPartitioningEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 100.0},
+            "replication_factor": 4,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 3},
+        max_partitions_per_op=max_partitions,
+        reward_function="job_acceptance",
+        max_simulation_run_time=1e5,
+        pad_obs_kwargs={"max_nodes": 64, "max_edges": 256})
+
+
+def _collect_cases(env, actions, n_cases):
+    """Step the env with the given action sequence, capturing
+    (host lookahead outputs, padded arrays) per successfully placed job."""
+    from ddls_tpu.sim.jax_lookahead import build_lookahead_arrays
+
+    cases = []
+    obs = env.reset(seed=0)
+    rng = np.random.RandomState(0)
+    cluster = env.cluster
+    orig = cluster._run_lookahead
+
+    def spy(job):
+        jct, comm, comp, profile = orig(job)
+        steps = job.num_training_steps
+        arrays = build_lookahead_arrays(cluster, job, pad_ops=160,
+                                        pad_deps=520, pad_links=2)
+        cases.append({"host": (jct / steps, comm / steps, comp / steps),
+                      "arrays": arrays})
+        return jct, comm, comp, profile
+
+    cluster._run_lookahead = spy
+    try:
+        i = 0
+        while len(cases) < n_cases:
+            mask = np.asarray(obs["action_mask"])
+            valid = np.nonzero(mask)[0]
+            if actions == "max":
+                a = int(valid[-1])
+            elif actions == "min":
+                a = int(valid[0])
+            else:
+                a = int(rng.choice(valid))
+            obs, _, done, _ = env.step(a)
+            i += 1
+            if done or i > 200:
+                obs = env.reset(seed=i)
+    finally:
+        cluster._run_lookahead = orig
+    return cases
+
+
+@pytest.mark.parametrize("actions", ["max", "random"])
+def test_matches_host_engine(dataset_dir, actions):
+    from ddls_tpu.sim.jax_lookahead import arrays_as_args, lookahead_fn
+
+    env = _make_env(dataset_dir)
+    cases = _collect_cases(env, actions, n_cases=6)
+    assert cases, "no lookahead cases captured"
+
+    fns = {}
+    for case in cases:
+        a = case["arrays"]
+        key = (a.num_workers, a.num_channels)
+        fn = fns.setdefault(key, lookahead_fn(*key))
+        t, comm, comp, ok = fn(*arrays_as_args(a))
+        assert bool(ok), "array engine failed to converge"
+        host_t, host_comm, host_comp = case["host"]
+        assert float(t) == pytest.approx(host_t, rel=1e-4), \
+            f"jct mismatch: jax {float(t)} vs host {host_t}"
+        assert float(comm) == pytest.approx(host_comm, rel=1e-4, abs=1e-6)
+        assert float(comp) == pytest.approx(host_comp, rel=1e-4, abs=1e-6)
+
+
+def test_vmapped_batch(dataset_dir):
+    """vmap over a batch of jobs padded to common shapes."""
+    from ddls_tpu.sim.jax_lookahead import (arrays_as_args,
+                                            batched_lookahead_fn)
+
+    env = _make_env(dataset_dir)
+    cases = _collect_cases(env, "random", n_cases=4)
+    # pad worker/channel statics to the max across the batch
+    W = max(c["arrays"].num_workers for c in cases)
+    C = max(c["arrays"].num_channels for c in cases)
+    fn = batched_lookahead_fn(W, C)
+    batch = [np.stack([arrays_as_args(c["arrays"])[k] for c in cases])
+             for k in range(13)]
+    t, comm, comp, ok = fn(*batch)
+    assert bool(np.all(ok))
+    for bi, case in enumerate(cases):
+        assert float(t[bi]) == pytest.approx(case["host"][0], rel=1e-4)
