@@ -186,9 +186,20 @@ class Action:
         }
         present = {k: a for k, a in self.actions.items() if a is not None}
         self.cause_of_unsuccessful_handling: Optional[str] = None
+        # per-job blocking cause: first sub-action (in pipeline order) that
+        # failed to handle the job (reference: actions/action.py:36-48)
+        self.job_id_to_cause_of_unsuccessful_handling: Dict[int, str] = {}
         if present:
             self.job_ids = set.intersection(
                 *[set(a.job_ids) for a in present.values()])
+            union = set.union(*[set(a.job_ids) for a in present.values()])
+            for job_id in union - self.job_ids:
+                for key in self.SUB_ACTIONS:
+                    act = self.actions[key]
+                    if act is not None and job_id not in act.job_ids:
+                        self.job_id_to_cause_of_unsuccessful_handling[
+                            job_id] = key
+                        break
             for key, act in present.items():
                 if not act.job_ids:
                     self.cause_of_unsuccessful_handling = key

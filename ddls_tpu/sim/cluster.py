@@ -49,7 +49,8 @@ from ddls_tpu.demands.job import Job
 from ddls_tpu.demands.job_queue import JobQueue
 from ddls_tpu.demands.jobs_generator import JobsGenerator
 from ddls_tpu.hardware.topologies import build_topology
-from ddls_tpu.utils import Stopwatch, seed_everything, unique_experiment_dir
+from ddls_tpu.utils import (SqliteDict, Stopwatch, seed_everything,
+                            unique_experiment_dir)
 
 EdgeId = Tuple[str, str]
 
@@ -61,10 +62,11 @@ class RampClusterEnvironment:
                  name: str = "ramp_cluster",
                  path_to_save: Optional[str] = None,
                  save_freq: int = 1,
-                 use_sqlite_database: bool = False,  # accepted for config parity
+                 use_sqlite_database: bool = False,
                  suppress_warnings: bool = True,
                  machine_epsilon: float = 1e-7):
         self.name = name
+        self.use_sqlite_database = use_sqlite_database
         self.machine_epsilon = machine_epsilon
         self.suppress_warnings = suppress_warnings
         self.save_freq = save_freq
@@ -376,14 +378,20 @@ class RampClusterEnvironment:
         """(reference: :793-892)"""
         if jct > job.max_acceptable_jct:
             # SLA violated: block the original job, unmount the partitioned one
-            self._register_blocked_job(job.original_job)
+            self._register_blocked_job(
+                job.original_job,
+                cause="max_acceptable_job_completion_time_exceeded")
             self._remove_job_from_cluster(job)
             return
 
+        # tick_profile covers ONE training step; normalise by the single-step
+        # time (jct / num_training_steps), not the full scaled JCT
         n_mounted = max(len(job.details["mounted_workers"]), 1)
+        step_time = jct / max(job.num_training_steps, 1)
         util = 0.0
         for active, tick in tick_profile:
-            util += (active / n_mounted) * (tick / jct) if jct > 0 else 0.0
+            util += ((active / n_mounted) * (tick / step_time)
+                     if step_time > 0 else 0.0)
 
         job.details["lookahead_job_completion_time"] = jct
         job.details["communication_overhead_time"] = comm_oh
@@ -402,10 +410,14 @@ class RampClusterEnvironment:
         self.action = action
         self.step_stats = self._init_step_stats()
 
-        # queued jobs not handled by every sub-action are blocked
+        # queued jobs not handled by every sub-action are blocked; the cause
+        # is the first sub-action that dropped the job (reference:
+        # action.py:36-48 surfaced into blocked stats)
         for job_id, job in list(self.job_queue.jobs.items()):
             if job_id not in action.job_ids:
-                self._register_blocked_job(job)
+                cause = action.job_id_to_cause_of_unsuccessful_handling.get(
+                    job_id, "not_handled")
+                self._register_blocked_job(job, cause=cause)
 
         if action.actions["op_partition"] is not None:
             self._partition_ops(action.actions["op_partition"])
@@ -454,7 +466,8 @@ class RampClusterEnvironment:
                     if self.job_queue.can_fit(nxt):
                         self.job_queue.add(nxt)
                     else:
-                        self._register_blocked_job(nxt)
+                        self._register_blocked_job(
+                            nxt, cause="job_queue_full")
                     step_done = True
             else:
                 self.time_next_job_to_arrive = float("inf")
@@ -622,7 +635,8 @@ class RampClusterEnvironment:
 
         self._remove_job_from_cluster(job)
 
-    def _register_blocked_job(self, job: Job) -> None:
+    def _register_blocked_job(self, job: Job,
+                              cause: str = "not_handled") -> None:
         job_idx = job.details["job_idx"]
         if job.job_id in self.job_queue.jobs:
             self.job_queue.remove(job)
@@ -633,6 +647,7 @@ class RampClusterEnvironment:
         self.step_stats["num_jobs_blocked"] += 1
         self.episode_stats["num_jobs_blocked"] += 1
         e = self.episode_stats
+        e["jobs_blocked_cause_of_unsuccessful_handling"].append(cause)
         e["jobs_blocked_num_nodes"].append(job.graph.n_ops)
         e["jobs_blocked_num_edges"].append(job.graph.n_deps)
         e["jobs_blocked_total_operation_memory_cost"].append(
@@ -736,7 +751,8 @@ class RampClusterEnvironment:
     def _finalise_episode_stats(self) -> None:
         # block anything still running at simulation end
         for job in list(self.jobs_running.values()):
-            self._register_blocked_job(job.original_job)
+            self._register_blocked_job(job.original_job,
+                                       cause="simulation_ended")
             self._remove_job_from_cluster(job)
         e = self.episode_stats
         e["episode_end_time"] = self.stopwatch.time()
@@ -777,9 +793,21 @@ class RampClusterEnvironment:
     def _save_logs(self, logs: dict) -> None:
         out_dir = pathlib.Path(self.path_to_save) / f"reset_{self.reset_counter}"
         out_dir.mkdir(parents=True, exist_ok=True)
-        for log_name, log in logs.items():
-            with gzip.open(out_dir / f"{log_name}.pkl", "wb") as f:
-                pickle.dump(dict(log), f)
+        if self.use_sqlite_database:
+            # one kv database per log, keys overwritten with the latest
+            # accumulated state (reference: ramp_cluster_environment.py:1570)
+            for log_name, log in logs.items():
+                db = SqliteDict(str(out_dir / f"{log_name}.sqlite"))
+                try:
+                    for key, val in dict(log).items():
+                        db[key] = val
+                    db.commit()
+                finally:
+                    db.close()
+        else:
+            for log_name, log in logs.items():
+                with gzip.open(out_dir / f"{log_name}.pkl", "wb") as f:
+                    pickle.dump(dict(log), f)
 
     def save(self) -> None:
         if self._save_thread is not None:
@@ -851,4 +879,5 @@ class RampClusterEnvironment:
             "jobs_blocked_original_demand_num_edges",
             "jobs_blocked_original_demand_total_operation_memory_cost",
             "jobs_blocked_original_demand_total_dependency_size",
+            "jobs_blocked_cause_of_unsuccessful_handling",
         }

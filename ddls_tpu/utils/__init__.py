@@ -1,7 +1,9 @@
 from ddls_tpu.utils.common import (
+    SqliteDict,
     Stopwatch,
     flatten_lists,
     get_class_from_path,
+    merge_logs,
     prng_key,
     seed_everything,
     unique_experiment_dir,
@@ -9,9 +11,11 @@ from ddls_tpu.utils.common import (
 )
 
 __all__ = [
+    "SqliteDict",
     "Stopwatch",
     "flatten_lists",
     "get_class_from_path",
+    "merge_logs",
     "prng_key",
     "seed_everything",
     "unique_experiment_dir",

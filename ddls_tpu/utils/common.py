@@ -9,10 +9,66 @@ from __future__ import annotations
 import glob
 import importlib
 import pathlib
+import pickle
 import random
+import sqlite3
 from typing import Any, Mapping
 
 import numpy as np
+
+
+class SqliteDict:
+    """Minimal persistent dict over stdlib sqlite3 (sqlitedict stand-in used
+    by the reference's Logger/cluster save paths)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._conn = sqlite3.connect(self.path)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (key TEXT PRIMARY KEY, val BLOB)")
+        self._conn.commit()
+
+    def __setitem__(self, key: str, value: Any) -> None:
+        self._conn.execute(
+            "REPLACE INTO kv (key, val) VALUES (?, ?)",
+            (key, pickle.dumps(value)))
+
+    def __getitem__(self, key: str) -> Any:
+        row = self._conn.execute(
+            "SELECT val FROM kv WHERE key = ?", (key,)).fetchone()
+        if row is None:
+            raise KeyError(key)
+        return pickle.loads(row[0])
+
+    def get(self, key: str, default: Any = None) -> Any:
+        try:
+            return self[key]
+        except KeyError:
+            return default
+
+    def keys(self):
+        return [r[0] for r in
+                self._conn.execute("SELECT key FROM kv").fetchall()]
+
+    def commit(self) -> None:
+        self._conn.commit()
+
+    def close(self) -> None:
+        self._conn.commit()
+        self._conn.close()
+
+
+def merge_logs(old: Any, new: Any) -> Any:
+    """Extend-by-key merge for incremental log flushes: dicts merge
+    recursively, lists extend, scalars overwrite."""
+    if isinstance(old, dict) and isinstance(new, dict):
+        out = dict(old)
+        for k, v in new.items():
+            out[k] = merge_logs(out.get(k), v) if k in out else v
+        return out
+    if isinstance(old, list) and isinstance(new, list):
+        return old + new
+    return new
 
 
 class Stopwatch:

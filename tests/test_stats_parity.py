@@ -1,0 +1,279 @@
+"""Stats-engine parity tests: exact step/episode stat values on
+hand-computable scenarios, per-job blocking causes, pbtxt reader coverage,
+and the cluster's SQLite save backend.
+
+These pin the quantities the reference's paper figures are built from
+(reference: ramp_cluster_environment.py:956-1167 stats engine,
+actions/action.py:36-48 blocking causes).
+"""
+import pytest
+
+from ddls_tpu.agents import (FirstFitDepPlacer, RampFirstFitOpPlacer,
+                             SRPTDepScheduler, SRPTOpScheduler)
+from ddls_tpu.agents.partitioners import build_partition_action
+from ddls_tpu.graphs.readers import graph_from_pbtxt, read_graph_file
+from ddls_tpu.sim import Action, OpPartition, RampClusterEnvironment
+from ddls_tpu.sim.actions import OpPlacement
+from ddls_tpu.utils import SqliteDict
+
+
+def _single_op_profile(tmp_path):
+    """One forward op: fwd=2, bwd=4, activation=100, parameter=10.
+
+    Mirrored graph: fwd op "1" (compute 2, memory 110), bwd op "2"
+    (compute 4, memory 110), join edge (1, 2) of size 100 (the producer's
+    activation). Placed unpartitioned on one worker every dep is a non-flow,
+    so per-training-step time is exactly 2 + 4 = 6.
+    """
+    path = tmp_path / "tiny.txt"
+    path.write_text(
+        "node1 -- Linear(id=1) -- forward_compute_time=2.0, "
+        "backward_compute_time=4.0, activation_size=100.0, "
+        "parameter_size=10.0\n")
+    return str(tmp_path)
+
+
+def _make_cluster(**kwargs):
+    return RampClusterEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        **kwargs)
+
+
+def _jobs_config(path, steps=5, frac=1.0, mode="remove"):
+    return {
+        "path_to_files": path,
+        "job_interarrival_time_dist": {
+            "_target_": "ddls_tpu.demands.distributions.Fixed", "val": 1e6},
+        "max_acceptable_job_completion_time_frac_dist": {
+            "_target_": "ddls_tpu.demands.distributions.Fixed", "val": frac},
+        "replication_factor": 1,
+        "num_training_steps": steps,
+        "job_sampling_mode": mode,
+    }
+
+
+def _heuristic_action(cluster, max_parts=1):
+    action_map = {}
+    for job_id, job in cluster.job_queue.jobs.items():
+        action_map[job_id] = build_partition_action(
+            job.graph, min_op_run_time_quantum=0.01,
+            max_partitions_per_op=max_parts)
+    op_partition = OpPartition(action_map, cluster=cluster)
+    op_placement = RampFirstFitOpPlacer().get(op_partition, cluster)
+    op_schedule = SRPTOpScheduler().get(op_partition, op_placement, cluster)
+    dep_placement = FirstFitDepPlacer().get(op_partition, op_placement, cluster)
+    dep_schedule = SRPTDepScheduler().get(op_partition, dep_placement, cluster)
+    return Action(op_partition=op_partition, op_placement=op_placement,
+                  op_schedule=op_schedule, dep_placement=dep_placement,
+                  dep_schedule=dep_schedule)
+
+
+# ------------------------------------------------------------- pinned values
+def test_single_job_episode_stats_exact(tmp_path):
+    """Every headline stat on a one-op job placed on one worker, where each
+    quantity is computable by hand:
+
+    per-step time = 2 + 4 = 6; JCT = 6 * 5 steps = 30; total op memory
+    cost = 2 * (100 + 10) = 220; total dep size = 100 (join edge); all
+    deps are non-flows.
+    """
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(_single_op_profile(tmp_path), steps=5),
+                  max_simulation_run_time=None, seed=0)
+    cluster.step(_heuristic_action(cluster))
+    assert cluster.is_done()
+
+    e = cluster.episode_stats
+    assert e["num_jobs_arrived"] == 1
+    assert e["num_jobs_completed"] == 1
+    assert e["num_jobs_blocked"] == 0
+    assert e["blocking_rate"] == 0.0
+    assert e["acceptance_rate"] == 1.0
+    assert e["job_completion_time"] == [pytest.approx(30.0)]
+    assert e["job_completion_time_speedup"] == [pytest.approx(1.0)]
+    assert e["job_communication_overhead_time"] == [pytest.approx(0.0)]
+    assert e["job_computation_overhead_time"] == [pytest.approx(30.0)]
+    assert e["jobs_completed_num_nodes"] == [2]
+    assert e["jobs_completed_num_edges"] == [1]
+    assert e["jobs_completed_total_operation_memory_cost"] == (
+        [pytest.approx(220.0)])
+    # the partition transform re-bases edge sizes on the producer's memory
+    # cost (activation + parameter = 110), matching the reference's
+    # data_split_node semantics
+    assert e["jobs_completed_total_dependency_size"] == [pytest.approx(110.0)]
+    assert e["jobs_completed_num_mounted_workers"] == [1]
+    assert e["jobs_completed_num_mounted_channels"] == [0]
+    # the single mounted worker is busy for the whole JCT
+    assert e["jobs_completed_mean_mounted_worker_utilisation_frac"] == (
+        [pytest.approx(1.0)])
+
+    assert e["episode_time"] == pytest.approx(30.0)
+    assert e["compute_info_processed"] == pytest.approx(220.0)
+    assert e["dep_info_processed"] == pytest.approx(110.0)
+    assert e["flow_info_processed"] == pytest.approx(0.0)
+    assert e["cluster_info_processed"] == pytest.approx(330.0)
+    assert e["mean_compute_throughput"] == pytest.approx(220.0 / 30.0)
+    assert e["mean_cluster_throughput"] == pytest.approx(330.0 / 30.0)
+    # original (pre-rebase) demand: 220 memory + 100 activation-sized dep
+    assert e["demand_total_info_processed"] == pytest.approx(320.0)
+    assert e["mean_demand_total_throughput"] == pytest.approx(320.0 / 30.0)
+
+    assert e["mean_num_jobs_running"] == pytest.approx(1.0)
+    assert e["mean_num_mounted_workers"] == pytest.approx(1.0)
+    assert e["mean_mounted_worker_utilisation_frac"] == pytest.approx(1.0)
+    # 1 of 8 workers mounted, fully utilised
+    assert e["mean_cluster_worker_utilisation_frac"] == pytest.approx(1 / 8)
+    assert e["mean_compute_overhead_frac"] == pytest.approx(1.0)
+    assert e["mean_communication_overhead_frac"] == pytest.approx(0.0)
+
+    # step-level mirror of the same quantities
+    s = cluster.steps_log
+    assert s["step_time"] == [pytest.approx(30.0)]
+    assert s["mean_compute_throughput"] == [pytest.approx(220.0 / 30.0)]
+    assert s["job_queue_length"] == [0]
+
+
+# ------------------------------------------------------------ blocking causes
+def test_blocked_cause_sla(tmp_path):
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(_single_op_profile(tmp_path), steps=5,
+                               frac=0.001), seed=0)
+    cluster.step(_heuristic_action(cluster))
+    assert cluster.episode_stats["num_jobs_blocked"] == 1
+    assert cluster.episode_stats[
+        "jobs_blocked_cause_of_unsuccessful_handling"] == (
+        ["max_acceptable_job_completion_time_exceeded"])
+
+
+def test_blocked_cause_sub_action(tmp_path):
+    """A job handled by op_partition but dropped by op_placement records
+    op_placement as its blocking cause (reference: action.py:36-48)."""
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(_single_op_profile(tmp_path)), seed=0)
+    job_id = next(iter(cluster.job_queue.jobs))
+    op_partition = OpPartition({job_id: {}}, cluster=cluster)
+    op_placement = OpPlacement({}, op_partition, cluster)  # placer failed
+    action = Action(op_partition=op_partition, op_placement=op_placement)
+    assert action.job_id_to_cause_of_unsuccessful_handling == {
+        job_id: "op_placement"}
+    cluster.step(action)
+    assert cluster.episode_stats[
+        "jobs_blocked_cause_of_unsuccessful_handling"] == ["op_placement"]
+
+
+def test_blocked_cause_queue_full(tmp_path):
+    cluster = _make_cluster()
+    cfg = _jobs_config(_single_op_profile(tmp_path))
+    cfg["replication_factor"] = 2
+    cfg["job_interarrival_time_dist"] = {
+        "_target_": "ddls_tpu.demands.distributions.Fixed", "val": 1.0}
+    cluster.reset(cfg, seed=0)
+    cluster.job_queue.queue_capacity = 0  # force the overflow path
+    cluster.step(Action())
+    causes = cluster.episode_stats[
+        "jobs_blocked_cause_of_unsuccessful_handling"]
+    assert causes[0] == "not_handled"       # queued job, empty action
+    assert "job_queue_full" in causes       # second arrival cannot fit
+
+
+# ------------------------------------------------------------------- sqlite
+def test_cluster_sqlite_save(tmp_path):
+    cluster = _make_cluster(path_to_save=str(tmp_path / "out"),
+                            use_sqlite_database=True)
+    cluster.reset(_jobs_config(_single_op_profile(tmp_path)),
+                  max_simulation_run_time=None, seed=0)
+    cluster.step(_heuristic_action(cluster))
+    assert cluster.is_done()
+    dbs = list((tmp_path / "out").rglob("*.sqlite"))
+    assert {p.name for p in dbs} == {"steps_log.sqlite",
+                                     "episode_stats.sqlite"}
+    db = SqliteDict(str([p for p in dbs if p.name ==
+                         "episode_stats.sqlite"][0]))
+    try:
+        assert db["num_jobs_completed"] == 1
+        assert db["job_completion_time"] == [pytest.approx(30.0)]
+    finally:
+        db.close()
+
+
+# -------------------------------------------------------------- pbtxt reader
+PBTXT = """node {
+  name: "op_a"
+  id: 1
+  output_info {
+    size: 64
+  }
+  compute_cost: 5
+}
+node {
+  name: "op_b"
+  id: 3
+  input_info {
+    preceding_node: 1
+  }
+  output_info {
+    size: 32
+  }
+  compute_cost: 7
+}
+node {
+  name: "op_c"
+  id: 7
+  input_info {
+    preceding_node: 3
+  }
+  control_input: 1
+  output_info {
+    size: 16
+  }
+  compute_cost: 2
+}
+"""
+
+
+def test_pbtxt_reader(tmp_path):
+    path = tmp_path / "g.pbtxt"
+    path.write_text(PBTXT)
+    g = graph_from_pbtxt(str(path), mirror=False)
+
+    # sparse ids 1, 3, 7 remapped to contiguous "1", "2", "3"
+    assert set(g.op_ids) == {"1", "2", "3"}
+    assert g.compute_cost("1") == 5.0
+    assert g.compute_cost("2") == 7.0
+    assert g.compute_cost("3") == 2.0
+    assert g.memory_cost("1") == 64.0
+
+    # data edges sized by the producer's (single) output size; control
+    # edges sized 0
+    assert g.edge_size("1", "2") == 64.0
+    assert g.edge_size("2", "3") == 32.0
+    assert g.edge_size("1", "3") == 0.0
+    assert g.n_deps == 3
+
+
+def test_pbtxt_reader_mirrored(tmp_path):
+    path = tmp_path / "g.pbtxt"
+    path.write_text(PBTXT)
+    g = graph_from_pbtxt(str(path), mirror=True)
+
+    # 3 forward + 3 mirrored backward ops; bwd id = 2n - (fwd - 1)
+    assert set(g.op_ids) == {"1", "2", "3", "4", "5", "6"}
+    assert g.compute_cost("6") == 5.0   # bwd of op 1
+    assert g.compute_cost("4") == 2.0   # bwd of op 3
+    # reflected backward edge for (1, 2) is (5, 6)
+    assert g.has_edge("5", "6")
+    # join edge from last fwd op to first bwd op
+    assert g.has_edge("3", "4")
+
+    # dispatch by extension
+    g2 = read_graph_file(str(path))
+    assert set(g2.op_ids) == set(g.op_ids)
