@@ -1,0 +1,276 @@
+"""Result loaders: saved experiment/cluster logs -> pandas frames.
+
+TPU-native counterpart of the reference's W&B run/sweep result loaders
+(ddls/environments/ramp_cluster/utils.py:129-473), reading from the local
+artifacts this framework writes instead of the W&B API:
+
+* a *run dir* written by ``scripts/train_from_config.py`` /
+  ``test_heuristic_from_config.py``: ``config.yaml`` +
+  ``results.pkl.gz`` (or ``results.sqlite``) produced by the Logger;
+* a *cluster save dir* written by ``RampClusterEnvironment.save``:
+  ``reset_<i>/{steps_log,episode_stats}.{pkl,sqlite}``;
+* a *sweep dir* written by ``scripts/run_sweep.py``: one run dir per
+  configuration.
+
+All loaders return plain dicts / :class:`pandas.DataFrame` so the plotting
+layer and notebooks can consume them directly.
+"""
+from __future__ import annotations
+
+import glob
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+import pandas as pd
+
+
+# ----------------------------------------------------------------- raw files
+def _load_pickle_or_sqlite(path: Path) -> Dict[str, Any]:
+    # single reader shared with the Logger so the save/load formats cannot
+    # drift apart
+    from ddls_tpu.train.logger import Logger
+
+    return Logger.load(str(path))
+
+
+def _find_results_file(run_dir: Path) -> Optional[Path]:
+    for pattern in ("results.pkl.gz", "results.sqlite",
+                    "**/results.pkl.gz", "**/results.sqlite"):
+        hits = sorted(run_dir.glob(pattern))
+        if hits:
+            return hits[0]
+    return None
+
+
+def _load_yaml(path: Path) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+@dataclass
+class RunResults:
+    """One experiment run: its config, its logged results, and a label."""
+
+    name: str
+    path: str
+    results: Dict[str, Any]
+    config: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def kind(self) -> str:
+        if "epochs" in self.results:
+            return "training"
+        if "heuristic_eval" in self.results:
+            return "heuristic"
+        return "unknown"
+
+    def episode_stats(self) -> Dict[str, Any]:
+        """The final-episode cluster stats, whichever kind of run this is."""
+        if self.kind == "heuristic":
+            return self.results["heuristic_eval"].get("episode_stats", {})
+        if self.kind == "training":
+            for epoch in reversed(self.results["epochs"]):
+                ep = epoch.get("evaluation", {}).get("episode_stats")
+                if ep:
+                    return ep
+        return self.results.get("episode_stats", {})
+
+
+def load_run(path: Union[str, Path],
+             name: Optional[str] = None) -> RunResults:
+    """Load a run dir (or a results file directly) into a RunResults."""
+    path = Path(path)
+    if path.is_dir():
+        results_file = _find_results_file(path)
+        if results_file is None:
+            raise FileNotFoundError(f"no results file under {path}")
+    else:
+        results_file = path
+        path = path.parent
+    results = _load_pickle_or_sqlite(results_file)
+    config: Dict[str, Any] = {}
+    for candidate in (path / "config.yaml",
+                      results_file.parent / "config.yaml"):
+        if candidate.exists():
+            config = _load_yaml(candidate)
+            break
+    return RunResults(name=name or path.name, path=str(path),
+                      results=results, config=config)
+
+
+def load_runs(paths: Union[str, Sequence[Union[str, Path]]],
+              names: Optional[Sequence[str]] = None) -> List[RunResults]:
+    """Load several runs; ``paths`` may be a glob pattern or a list."""
+    if isinstance(paths, str):
+        paths = sorted(glob.glob(paths))
+    names = list(names) if names is not None else [None] * len(paths)
+    if len(names) != len(paths):
+        raise ValueError(f"{len(names)} names for {len(paths)} paths")
+    return [load_run(p, name=n) for p, n in zip(paths, names)]
+
+
+def load_cluster_save(save_dir: Union[str, Path],
+                      reset: Optional[int] = None) -> Dict[str, Any]:
+    """Load a RampClusterEnvironment save dir (``reset_<i>`` subdirs with
+    steps_log/episode_stats in either backend)."""
+    save_dir = Path(save_dir)
+    resets = sorted(save_dir.glob("reset_*"),
+                    key=lambda p: int(p.name.split("_")[-1]))
+    if not resets:
+        raise FileNotFoundError(f"no reset_* dirs under {save_dir}")
+    chosen = (resets[-1] if reset is None
+              else save_dir / f"reset_{reset}")
+    if not chosen.is_dir():
+        raise FileNotFoundError(
+            f"{chosen} does not exist; available: "
+            f"{[p.name for p in resets]}")
+    out = {}
+    for log_name in ("steps_log", "episode_stats"):
+        for suffix in (".pkl", ".sqlite"):
+            f = chosen / f"{log_name}{suffix}"
+            if f.exists():
+                out[log_name] = _load_pickle_or_sqlite(f)
+                break
+    return out
+
+
+# -------------------------------------------------------------------- frames
+def _flatten_scalars(node: Any, prefix: str = "",
+                     out: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    if out is None:
+        out = {}
+    if isinstance(node, dict):
+        for k, v in node.items():
+            _flatten_scalars(v, f"{prefix}{k}/", out)
+    elif isinstance(node, (int, float, np.floating, np.integer, bool)):
+        out[prefix[:-1]] = float(node)
+    return out
+
+
+def epochs_frame(run: RunResults) -> pd.DataFrame:
+    """One row per training epoch, nested scalar metrics flattened into
+    '/'-joined columns (the reference's RLlib-result flattening,
+    rllib_epoch_loop.py:105-230)."""
+    if run.kind != "training":
+        raise ValueError(f"run {run.name} has no epochs (kind={run.kind})")
+    rows = [_flatten_scalars(epoch) for epoch in run.results["epochs"]]
+    frame = pd.DataFrame(rows)
+    frame.insert(0, "epoch", np.arange(1, len(frame) + 1))
+    frame.insert(0, "run", run.name)
+    return frame
+
+
+def _per_job_frame(stats: Dict[str, Any], prefix: str,
+                   extra: Sequence[str] = ()) -> pd.DataFrame:
+    cols = {}
+    for key, val in stats.items():
+        if key.startswith(prefix) and isinstance(val, list):
+            cols[key[len(prefix):]] = val
+    for key in extra:
+        if isinstance(stats.get(key), list):
+            cols[key] = stats[key]
+    if not cols:
+        return pd.DataFrame()
+    n = min(len(v) for v in cols.values())
+    return pd.DataFrame({k: v[:n] for k, v in cols.items()})
+
+
+def completed_jobs_frame(run: RunResults) -> pd.DataFrame:
+    """Per-completed-job characteristics (the reference eval tables,
+    rllib_eval_loop.py:123-158)."""
+    stats = run.episode_stats()
+    frame = _per_job_frame(
+        stats, "jobs_completed_",
+        extra=("job_completion_time", "job_completion_time_speedup",
+               "job_communication_overhead_time",
+               "job_computation_overhead_time"))
+    if len(frame):
+        frame.insert(0, "run", run.name)
+    return frame
+
+
+def blocked_jobs_frame(run: RunResults) -> pd.DataFrame:
+    stats = run.episode_stats()
+    frame = _per_job_frame(stats, "jobs_blocked_")
+    if len(frame):
+        frame.insert(0, "run", run.name)
+    return frame
+
+
+def steps_frame(source: Union[RunResults, Dict[str, Any]]) -> pd.DataFrame:
+    """Per-simulator-step stats as a frame (from a run's harvested
+    steps_log or a cluster save dict)."""
+    if isinstance(source, RunResults):
+        if source.kind == "heuristic":
+            log = source.results["heuristic_eval"].get("steps_log", {})
+        else:
+            log = source.results.get("steps_log", {})
+    else:
+        log = source.get("steps_log", source)
+    lists = {k: v for k, v in log.items() if isinstance(v, list)}
+    if not lists:
+        return pd.DataFrame()
+    n = min(len(v) for v in lists.values())
+    return pd.DataFrame({k: v[:n] for k, v in lists.items()})
+
+
+HEADLINE_METRICS = (
+    "blocking_rate", "acceptance_rate", "mean_load_rate",
+    "mean_cluster_throughput", "mean_demand_total_throughput",
+    "mean_compute_overhead_frac", "mean_communication_overhead_frac",
+    "mean_mounted_worker_utilisation_frac",
+    "mean_cluster_worker_utilisation_frac",
+    "num_jobs_arrived", "num_jobs_completed", "num_jobs_blocked",
+)
+
+
+def summary_table(runs: Sequence[RunResults]) -> pd.DataFrame:
+    """Cross-run comparison of headline metrics plus mean per-job JCT and
+    speedup -- the numbers behind the paper's comparison figures."""
+    rows = []
+    for run in runs:
+        stats = run.episode_stats()
+        row: Dict[str, Any] = {"run": run.name, "kind": run.kind}
+        for metric in HEADLINE_METRICS:
+            val = stats.get(metric)
+            row[metric] = float(val) if val is not None else np.nan
+        jcts = stats.get("job_completion_time") or []
+        speedups = stats.get("job_completion_time_speedup") or []
+        row["mean_job_completion_time"] = (
+            float(np.mean(jcts)) if jcts else np.nan)
+        row["p99_job_completion_time"] = (
+            float(np.percentile(jcts, 99)) if jcts else np.nan)
+        row["mean_job_completion_time_speedup"] = (
+            float(np.mean(speedups)) if speedups else np.nan)
+        if run.kind == "heuristic":
+            row["episode_return"] = run.results["heuristic_eval"].get(
+                "episode_return", np.nan)
+        elif run.kind == "training":
+            returns = []
+            for ep in run.results["epochs"]:
+                flat = _flatten_scalars(ep)
+                val = flat.get("evaluation/episode_reward_mean")
+                if val is None:  # 0.0 is a legitimate reward
+                    val = flat.get("episode_reward_mean")
+                returns.append(val)
+            returns = [r for r in returns if r is not None]
+            row["episode_return"] = returns[-1] if returns else np.nan
+        rows.append(row)
+    return pd.DataFrame(rows)
+
+
+def blocked_cause_table(runs: Sequence[RunResults]) -> pd.DataFrame:
+    """Per-run counts of each blocking cause."""
+    rows = []
+    for run in runs:
+        causes = run.episode_stats().get(
+            "jobs_blocked_cause_of_unsuccessful_handling") or []
+        row = {"run": run.name}
+        for cause in causes:
+            row[cause] = row.get(cause, 0) + 1
+        rows.append(row)
+    return pd.DataFrame(rows).fillna(0)

@@ -1,0 +1,254 @@
+"""Plotting: the paper's headline figures from loaded results.
+
+Counterpart of the reference's plotting layer
+(ddls/plotting/plotting.py:15-440): publication-style plot parameters,
+computation-graph rendering, and the learner-vs-baseline comparison figures
+(learning curves, JCT/blocking comparisons, per-job distributions) its
+notebooks build. Implemented on matplotlib directly (the reference wraps
+seaborn) and fed from :mod:`ddls_tpu.analysis.loaders` frames.
+"""
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Union
+
+import matplotlib
+import numpy as np
+
+matplotlib.use("Agg")  # headless; figures are saved, not shown
+
+import matplotlib.pyplot as plt  # noqa: E402
+
+from ddls_tpu.analysis.loaders import (RunResults, blocked_cause_table,
+                                       completed_jobs_frame, epochs_frame,
+                                       summary_table)
+
+# conference-style defaults (reference keeps an ICML param block,
+# plotting.py:15-60)
+PLOT_PARAMS = {
+    "figure.figsize": (5.5, 3.4),
+    "figure.dpi": 120,
+    "font.size": 9,
+    "axes.titlesize": 9,
+    "axes.labelsize": 9,
+    "legend.fontsize": 8,
+    "xtick.labelsize": 8,
+    "ytick.labelsize": 8,
+    "axes.spines.top": False,
+    "axes.spines.right": False,
+    "axes.grid": True,
+    "grid.alpha": 0.3,
+    "savefig.bbox": "tight",
+}
+
+
+def apply_plot_style() -> None:
+    plt.rcParams.update(PLOT_PARAMS)
+
+
+def _save(fig, path: Optional[Union[str, Path]]):
+    if path is not None:
+        Path(path).parent.mkdir(parents=True, exist_ok=True)
+        fig.savefig(path)
+        plt.close(fig)
+    return fig
+
+
+# ------------------------------------------------------------ learning curves
+def plot_learning_curves(training_runs: Sequence[RunResults],
+                         metric: str = "evaluation/episode_reward_mean",
+                         baseline_runs: Sequence[RunResults] = (),
+                         smooth: int = 1,
+                         path: Optional[str] = None):
+    """Learner metric vs epoch, with heuristic baselines as horizontal
+    lines -- the paper's learner-vs-baseline curve."""
+    apply_plot_style()
+    fig, ax = plt.subplots()
+    for run in training_runs:
+        frame = epochs_frame(run)
+        col = metric if metric in frame.columns else None
+        if col is None:
+            # fall back to any column whose tail matches
+            tails = [c for c in frame.columns if c.endswith(metric)]
+            if not tails:
+                continue
+            col = tails[0]
+        ys = frame[col].astype(float)
+        if smooth > 1:
+            ys = ys.rolling(smooth, min_periods=1).mean()
+        ax.plot(frame["epoch"], ys, label=run.name)
+    for run in baseline_runs:
+        val = run.results.get("heuristic_eval", {}).get("episode_return")
+        if val is not None:
+            ax.axhline(float(val), linestyle="--", linewidth=1, alpha=0.8,
+                       label=f"{run.name} (heuristic)")
+    ax.set_xlabel("epoch")
+    ax.set_ylabel(metric)
+    ax.legend(loc="best")
+    return _save(fig, path)
+
+
+# --------------------------------------------------------------- comparisons
+def plot_headline_comparison(runs: Sequence[RunResults],
+                             metrics: Sequence[str] = (
+                                 "blocking_rate", "acceptance_rate",
+                                 "mean_job_completion_time_speedup",
+                                 "mean_cluster_throughput"),
+                             path: Optional[str] = None):
+    """Grouped bar chart of headline episode metrics per run."""
+    apply_plot_style()
+    table = summary_table(runs)
+    n = len(metrics)
+    fig, axes = plt.subplots(1, n, figsize=(2.2 * n, 2.8))
+    if n == 1:
+        axes = [axes]
+    for ax, metric in zip(axes, metrics):
+        vals = table[metric].astype(float)
+        ax.bar(range(len(table)), vals)
+        ax.set_xticks(range(len(table)))
+        ax.set_xticklabels(table["run"], rotation=45, ha="right")
+        ax.set_title(metric, fontsize=8)
+    fig.tight_layout()
+    return _save(fig, path)
+
+
+def plot_jct_cdf(runs: Sequence[RunResults],
+                 speedup: bool = False,
+                 path: Optional[str] = None):
+    """Empirical CDF of per-job completion time (or speedup) per run."""
+    apply_plot_style()
+    fig, ax = plt.subplots()
+    col = ("job_completion_time_speedup" if speedup
+           else "job_completion_time")
+    for run in runs:
+        frame = completed_jobs_frame(run)
+        if col not in frame.columns or not len(frame):
+            continue
+        xs = np.sort(frame[col].astype(float).to_numpy())
+        ys = np.arange(1, len(xs) + 1) / len(xs)
+        ax.step(xs, ys, where="post", label=run.name)
+    ax.set_xlabel("JCT speedup vs sequential" if speedup
+                  else "job completion time")
+    ax.set_ylabel("CDF")
+    if not speedup:
+        ax.set_xscale("log")
+    ax.legend(loc="best")
+    return _save(fig, path)
+
+
+def plot_blocked_causes(runs: Sequence[RunResults],
+                        path: Optional[str] = None):
+    """Stacked bars of blocking causes per run."""
+    apply_plot_style()
+    table = blocked_cause_table(runs)
+    causes = [c for c in table.columns if c != "run"]
+    fig, ax = plt.subplots()
+    bottom = np.zeros(len(table))
+    for cause in causes:
+        vals = table[cause].astype(float).to_numpy()
+        ax.bar(range(len(table)), vals, bottom=bottom, label=cause)
+        bottom += vals
+    ax.set_xticks(range(len(table)))
+    ax.set_xticklabels(table["run"], rotation=45, ha="right")
+    ax.set_ylabel("blocked jobs")
+    if causes:
+        ax.legend(loc="best", fontsize=7)
+    return _save(fig, path)
+
+
+def plot_metric_hist(values_by_run: Dict[str, Sequence[float]],
+                     xlabel: str = "",
+                     bins: int = 30,
+                     path: Optional[str] = None):
+    """Overlaid histograms (reference's seaborn hist wrapper)."""
+    apply_plot_style()
+    fig, ax = plt.subplots()
+    for name, values in values_by_run.items():
+        ax.hist(np.asarray(values, dtype=float), bins=bins, alpha=0.5,
+                label=name)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel("count")
+    ax.legend(loc="best")
+    return _save(fig, path)
+
+
+# --------------------------------------------------------- graph rendering
+def render_op_graph(graph, path: Optional[str] = None,
+                    color_by: str = "pass"):
+    """Render a computation graph layered by dependency depth (reference
+    renders via networkx/pygraphviz, plotting.py:62-130; OpGraph is
+    array-native so a longest-path layering is computed directly)."""
+    apply_plot_style()
+    order = graph.topo_order()
+    depth = {op: 0 for op in order}
+    for op in order:
+        for child in graph.successors(op):
+            depth[child] = max(depth[child], depth[op] + 1)
+    by_depth: Dict[int, List[str]] = {}
+    for op, d in depth.items():
+        by_depth.setdefault(d, []).append(op)
+    pos = {}
+    for d, ops in by_depth.items():
+        for i, op in enumerate(sorted(ops, key=str)):
+            pos[op] = (i - (len(ops) - 1) / 2, -d)
+
+    fig, ax = plt.subplots(figsize=(6, max(3, 0.45 * (max(by_depth) + 1))))
+    for u, v in graph.edge_ids:
+        (x0, y0), (x1, y1) = pos[u], pos[v]
+        ax.annotate("", xy=(x1, y1), xytext=(x0, y0),
+                    arrowprops=dict(arrowstyle="->", color="0.6", lw=0.7))
+    sizes = np.array([graph.compute_cost(op) for op in pos])
+    smax = sizes.max() if sizes.max() > 0 else 1.0
+    for op, (x, y) in pos.items():
+        if color_by == "pass":
+            color = ("tab:blue" if graph.is_forward(op) else "tab:orange")
+        else:
+            color = "tab:blue"
+        size = 120 + 260 * graph.compute_cost(op) / smax
+        ax.scatter([x], [y], s=size, c=color, zorder=3,
+                   edgecolors="white", linewidths=0.8)
+        ax.annotate(op, (x, y), ha="center", va="center", fontsize=6,
+                    zorder=4)
+    ax.set_axis_off()
+    return _save(fig, path)
+
+
+# ------------------------------------------------------------------- report
+def save_comparison_report(runs: Sequence[RunResults],
+                           out_dir: Union[str, Path],
+                           metric: str = "evaluation/episode_reward_mean"
+                           ) -> Dict[str, str]:
+    """One command: all comparison artifacts (CSV + PNG) into ``out_dir``.
+
+    This is the product of the analysis layer: the learner-vs-baseline
+    curves and JCT/blocking comparisons the reference's paper notebooks
+    assemble by hand.
+    """
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    artifacts: Dict[str, str] = {}
+
+    table = summary_table(runs)
+    table.to_csv(out_dir / "summary.csv", index=False)
+    artifacts["summary"] = str(out_dir / "summary.csv")
+
+    causes = blocked_cause_table(runs)
+    causes.to_csv(out_dir / "blocked_causes.csv", index=False)
+    artifacts["blocked_causes"] = str(out_dir / "blocked_causes.csv")
+
+    training = [r for r in runs if r.kind == "training"]
+    heuristics = [r for r in runs if r.kind == "heuristic"]
+    if training:
+        plot_learning_curves(training, metric=metric,
+                             baseline_runs=heuristics,
+                             path=out_dir / "learning_curves.png")
+        artifacts["learning_curves"] = str(out_dir / "learning_curves.png")
+    plot_headline_comparison(runs, path=out_dir / "comparison.png")
+    artifacts["comparison"] = str(out_dir / "comparison.png")
+    plot_jct_cdf(runs, path=out_dir / "jct_cdf.png")
+    artifacts["jct_cdf"] = str(out_dir / "jct_cdf.png")
+    plot_jct_cdf(runs, speedup=True, path=out_dir / "jct_speedup_cdf.png")
+    artifacts["jct_speedup_cdf"] = str(out_dir / "jct_speedup_cdf.png")
+    plot_blocked_causes(runs, path=out_dir / "blocked_causes.png")
+    artifacts["blocked_causes_png"] = str(out_dir / "blocked_causes.png")
+    return artifacts

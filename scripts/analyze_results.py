@@ -1,0 +1,54 @@
+"""Turn saved runs into the paper's comparison figures with one command.
+
+TPU-native counterpart of the reference's plotting notebooks + W&B loaders
+(ddls/plotting/plotting.py, ramp_cluster/utils.py:129-473):
+
+    python scripts/analyze_results.py RUN_DIR [RUN_DIR ...] \
+        --names ppo acceptable_jct sipml --out /tmp/analysis
+
+writes summary.csv, blocked_causes.csv, learning_curves.png (if any
+training runs), comparison.png, jct_cdf.png, jct_speedup_cdf.png and
+blocked_causes.png, and prints the summary table.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddls_tpu.analysis import load_runs, save_comparison_report, summary_table
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("runs", nargs="+",
+                        help="run dirs (or results files) to compare")
+    parser.add_argument("--names", nargs="*", default=None,
+                        help="labels, one per run (default: dir names)")
+    parser.add_argument("--out", default="analysis_out",
+                        help="output dir for CSV/PNG artifacts")
+    parser.add_argument("--metric",
+                        default="evaluation/episode_reward_mean",
+                        help="learning-curve metric (flattened '/'-path)")
+    args = parser.parse_args(argv)
+
+    runs = load_runs(args.runs, names=args.names)
+    artifacts = save_comparison_report(runs, args.out, metric=args.metric)
+
+    table = summary_table(runs)
+    with_cols = [c for c in ("run", "kind", "episode_return",
+                             "blocking_rate", "acceptance_rate",
+                             "mean_job_completion_time",
+                             "mean_job_completion_time_speedup")
+                 if c in table.columns]
+    print(table[with_cols].to_string(index=False))
+    print("\nArtifacts:")
+    for name, path in artifacts.items():
+        print(f"  {name}: {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

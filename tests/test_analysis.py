@@ -1,0 +1,166 @@
+"""L9 analysis layer: loaders, frames, summary tables, plots, report CLI."""
+import numpy as np
+import pytest
+
+from ddls_tpu.analysis import (blocked_cause_table, completed_jobs_frame,
+                               epochs_frame, load_cluster_save, load_run,
+                               load_runs, render_op_graph,
+                               save_comparison_report, steps_frame,
+                               summary_table)
+from ddls_tpu.train.logger import Logger
+
+
+def _heuristic_results(name, blocking_rate, jcts):
+    n = len(jcts)
+    return {
+        "heuristic_eval": {
+            "episode_return": float(100 - blocking_rate * 100),
+            "episode_length": n,
+            "episode_stats": {
+                "num_jobs_arrived": n + 2,
+                "num_jobs_completed": n,
+                "num_jobs_blocked": 2,
+                "blocking_rate": blocking_rate,
+                "acceptance_rate": 1.0 - blocking_rate,
+                "mean_cluster_throughput": 12.5,
+                "job_completion_time": list(jcts),
+                "job_completion_time_speedup": [2.0] * n,
+                "jobs_completed_num_nodes": [4] * n,
+                "jobs_blocked_num_nodes": [6, 8],
+                "jobs_blocked_cause_of_unsuccessful_handling": [
+                    "op_placement",
+                    "max_acceptable_job_completion_time_exceeded"],
+            },
+            "steps_log": {
+                "step_time": [1.0] * 5,
+                "mean_cluster_throughput": [10.0] * 5,
+            },
+        }
+    }
+
+
+def _training_results(n_epochs=4):
+    return {
+        "epochs": [
+            {"episode_reward_mean": float(i),
+             "evaluation": {"episode_reward_mean": float(i) + 0.5,
+                            "episode_stats": {
+                                "blocking_rate": 0.1,
+                                "acceptance_rate": 0.9,
+                                "job_completion_time": [5.0, 6.0],
+                                "job_completion_time_speedup": [3.0, 2.5]}},
+             "epoch_time": 1.0}
+            for i in range(n_epochs)
+        ]
+    }
+
+
+def _save_run(tmp_path, name, results, sqlite=False):
+    d = tmp_path / name
+    logger = Logger(path_to_save=str(d), use_sqlite_database=sqlite)
+    logger.log(results)
+    logger.save(blocking=True)
+    return str(d)
+
+
+def test_load_and_summary(tmp_path):
+    h1 = _save_run(tmp_path, "acceptable_jct",
+                   _heuristic_results("h1", 0.05, [10.0, 20.0, 30.0]))
+    h2 = _save_run(tmp_path, "sipml",
+                   _heuristic_results("h2", 0.20, [40.0, 50.0]),
+                   sqlite=True)
+    t1 = _save_run(tmp_path, "ppo", _training_results())
+
+    runs = load_runs([h1, h2, t1])
+    assert [r.kind for r in runs] == ["heuristic", "heuristic", "training"]
+
+    table = summary_table(runs)
+    assert list(table["run"]) == ["acceptable_jct", "sipml", "ppo"]
+    row = table[table["run"] == "acceptable_jct"].iloc[0]
+    assert row["blocking_rate"] == pytest.approx(0.05)
+    assert row["mean_job_completion_time"] == pytest.approx(20.0)
+    # training run: final eval reward and eval episode stats used
+    row = table[table["run"] == "ppo"].iloc[0]
+    assert row["episode_return"] == pytest.approx(3.5)
+    assert row["blocking_rate"] == pytest.approx(0.1)
+
+
+def test_frames(tmp_path):
+    path = _save_run(tmp_path, "h",
+                     _heuristic_results("h", 0.1, [1.0, 2.0, 4.0]))
+    run = load_run(path)
+    jobs = completed_jobs_frame(run)
+    assert len(jobs) == 3
+    assert jobs["job_completion_time"].tolist() == [1.0, 2.0, 4.0]
+    assert jobs["num_nodes"].tolist() == [4, 4, 4]
+
+    steps = steps_frame(run)
+    assert len(steps) == 5
+    assert "mean_cluster_throughput" in steps.columns
+
+    causes = blocked_cause_table([run])
+    assert causes.iloc[0]["op_placement"] == 1
+
+    t = load_run(_save_run(tmp_path, "t", _training_results()))
+    frame = epochs_frame(t)
+    assert len(frame) == 4
+    assert frame["evaluation/episode_reward_mean"].tolist() == (
+        [0.5, 1.5, 2.5, 3.5])
+
+
+def test_comparison_report_and_cli(tmp_path):
+    paths = [
+        _save_run(tmp_path, "a", _heuristic_results("a", 0.1, [5.0, 7.0])),
+        _save_run(tmp_path, "b", _heuristic_results("b", 0.3, [9.0])),
+        _save_run(tmp_path, "t", _training_results()),
+    ]
+    runs = load_runs(paths, names=["A", "B", "PPO"])
+    out = tmp_path / "report"
+    artifacts = save_comparison_report(runs, out)
+    for key in ("summary", "comparison", "jct_cdf", "learning_curves",
+                "blocked_causes_png"):
+        assert key in artifacts
+    import pathlib
+    for path in artifacts.values():
+        assert pathlib.Path(path).exists()
+
+    # CLI end to end
+    import importlib
+    mod = importlib.import_module("scripts.analyze_results")
+    rc = mod.main(paths + ["--names", "A", "B", "PPO",
+                           "--out", str(tmp_path / "cli_out")])
+    assert rc == 0
+    assert (tmp_path / "cli_out" / "summary.csv").exists()
+
+
+def test_cluster_save_loader(tmp_path):
+    # reuse the cluster sqlite save from the stats tests' scenario shape
+    from tests.test_stats_parity import (_heuristic_action, _jobs_config,
+                                         _make_cluster, _single_op_profile)
+    cluster = _make_cluster(path_to_save=str(tmp_path / "sim"))
+    cluster.reset(_jobs_config(_single_op_profile(tmp_path)),
+                  max_simulation_run_time=None, seed=0)
+    cluster.step(_heuristic_action(cluster))
+    cluster._save_thread.join()
+    save_dir = cluster.path_to_save
+    logs = load_cluster_save(save_dir)
+    assert logs["episode_stats"]["num_jobs_completed"] == 1
+    frame = steps_frame(logs)
+    assert len(frame) == 1
+
+
+def test_render_op_graph(tmp_path):
+    from ddls_tpu.graphs.readers import graph_from_pipedream_txt
+    profile = tmp_path / "g.txt"
+    profile.write_text(
+        "node1 -- A(id=1) -- forward_compute_time=1.0, "
+        "backward_compute_time=2.0, activation_size=10.0, "
+        "parameter_size=1.0\n"
+        "node2 -- B(id=2) -- forward_compute_time=2.0, "
+        "backward_compute_time=4.0, activation_size=20.0, "
+        "parameter_size=2.0\n"
+        "node1 -- node2\n")
+    g = graph_from_pipedream_txt(str(profile))
+    out = tmp_path / "graph.png"
+    render_op_graph(g, path=out)
+    assert out.exists() and out.stat().st_size > 0
