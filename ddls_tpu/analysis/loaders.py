@@ -66,17 +66,39 @@ class RunResults:
             return "training"
         if "heuristic_eval" in self.results:
             return "heuristic"
+        if "rl_eval" in self.results:
+            return "rl_eval"
         return "unknown"
 
     def episode_stats(self) -> Dict[str, Any]:
-        """The final-episode cluster stats, whichever kind of run this is."""
+        """The final-episode cluster stats, whichever kind of run this is.
+
+        * heuristic runs (test_heuristic_from_config) store them whole;
+        * rl_eval runs (test_from_config) store one record per eval episode;
+        * training runs (train_from_config) log only the scalar
+          ``custom_metrics/*_mean`` summaries per epoch (loops.py
+          _episode_summary), so the last epoch's scalars are re-mapped into
+          an episode-stats-shaped dict (per-job lists are only available
+          from an rl_eval run of the checkpoint).
+        """
         if self.kind == "heuristic":
             return self.results["heuristic_eval"].get("episode_stats", {})
+        if self.kind == "rl_eval":
+            records = self.results["rl_eval"]
+            return records[-1].get("episode_stats", {}) if records else {}
         if self.kind == "training":
             for epoch in reversed(self.results["epochs"]):
-                ep = epoch.get("evaluation", {}).get("episode_stats")
-                if ep:
-                    return ep
+                evaluation = epoch.get("evaluation", {})
+                if "episode_stats" in evaluation:
+                    return evaluation["episode_stats"]
+                flat = _flatten_scalars(evaluation)
+                stats = {}
+                for key, val in flat.items():
+                    if key.startswith("custom_metrics/") and key.endswith(
+                            "_mean"):
+                        stats[key[len("custom_metrics/"):-len("_mean")]] = val
+                if stats:
+                    return stats
         return self.results.get("episode_stats", {})
 
 
@@ -207,6 +229,9 @@ def steps_frame(source: Union[RunResults, Dict[str, Any]]) -> pd.DataFrame:
     if isinstance(source, RunResults):
         if source.kind == "heuristic":
             log = source.results["heuristic_eval"].get("steps_log", {})
+        elif source.kind == "rl_eval":
+            records = source.results["rl_eval"]
+            log = records[-1].get("steps_log", {}) if records else {}
         else:
             log = source.results.get("steps_log", {})
     else:
@@ -240,15 +265,25 @@ def summary_table(runs: Sequence[RunResults]) -> pd.DataFrame:
             row[metric] = float(val) if val is not None else np.nan
         jcts = stats.get("job_completion_time") or []
         speedups = stats.get("job_completion_time_speedup") or []
+        # training runs only carry the scalar means, not per-job lists
         row["mean_job_completion_time"] = (
-            float(np.mean(jcts)) if jcts else np.nan)
+            float(np.mean(jcts)) if jcts
+            else float(stats.get("mean_job_completion_time", np.nan)))
         row["p99_job_completion_time"] = (
             float(np.percentile(jcts, 99)) if jcts else np.nan)
         row["mean_job_completion_time_speedup"] = (
-            float(np.mean(speedups)) if speedups else np.nan)
+            float(np.mean(speedups)) if speedups
+            else float(stats.get("mean_job_completion_time_speedup",
+                                 np.nan)))
         if run.kind == "heuristic":
             row["episode_return"] = run.results["heuristic_eval"].get(
                 "episode_return", np.nan)
+        elif run.kind == "rl_eval":
+            returns = [r.get("episode", {}).get("episode_return")
+                       for r in run.results["rl_eval"]]
+            returns = [r for r in returns if r is not None]
+            row["episode_return"] = (float(np.mean(returns))
+                                     if returns else np.nan)
         elif run.kind == "training":
             returns = []
             for ep in run.results["epochs"]:

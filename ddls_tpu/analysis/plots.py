@@ -12,10 +12,16 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Union
 
+import os
+import sys
+
 import matplotlib
 import numpy as np
 
-matplotlib.use("Agg")  # headless; figures are saved, not shown
+# headless default, but never hijack a backend the user already picked
+# (e.g. a notebook's inline backend imports pyplot before this module)
+if "matplotlib.pyplot" not in sys.modules and not os.environ.get("DISPLAY"):
+    matplotlib.use("Agg")
 
 import matplotlib.pyplot as plt  # noqa: E402
 
@@ -84,7 +90,8 @@ def plot_learning_curves(training_runs: Sequence[RunResults],
                        label=f"{run.name} (heuristic)")
     ax.set_xlabel("epoch")
     ax.set_ylabel(metric)
-    ax.legend(loc="best")
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend(loc="best")
     return _save(fig, path)
 
 
@@ -132,7 +139,8 @@ def plot_jct_cdf(runs: Sequence[RunResults],
     ax.set_ylabel("CDF")
     if not speedup:
         ax.set_xscale("log")
-    ax.legend(loc="best")
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend(loc="best")
     return _save(fig, path)
 
 
@@ -168,7 +176,8 @@ def plot_metric_hist(values_by_run: Dict[str, Sequence[float]],
                 label=name)
     ax.set_xlabel(xlabel)
     ax.set_ylabel("count")
-    ax.legend(loc="best")
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend(loc="best")
     return _save(fig, path)
 
 

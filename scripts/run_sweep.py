@@ -1,0 +1,229 @@
+"""Hyperparameter / baseline sweep orchestration.
+
+TPU-native counterpart of the reference's W&B sweep runner
+(scripts/run_wandb_sweep.py:1-121 + wandb_sweep_config.yaml): instead of
+spawning W&B agents in tmux windows, expands a YAML-defined parameter space
+(grid or random search) into concrete override sets, launches up to
+``max_parallel`` runs as subprocesses with staggered starts, and aggregates
+every run's saved results into a sweep-level comparison table via the
+analysis layer.
+
+    python scripts/run_sweep.py --sweep-config scripts/sweeps/heuristics.yaml
+
+Sweep YAML schema::
+
+    name: heuristic_actors
+    program: test_heuristic_from_config.py   # entry, relative to scripts/
+    config_path: ramp_job_partitioning_configs   # passed through
+    config_name: heuristic_config
+    method: grid            # grid | random
+    num_runs: 8             # random only
+    max_parallel: 4
+    stagger_seconds: 1.0
+    path_to_save: /tmp/ddls_tpu/sweeps
+    overrides:              # fixed overrides applied to every run
+      - experiment.seed=0
+    parameters:             # the swept space
+      eval_loop.actor._target_:
+        values: [ddls_tpu.envs.baselines.AcceptableJCT,
+                 ddls_tpu.envs.baselines.SiPML]
+      algo.lr:              # random method: distributions
+        distribution: log_uniform
+        min: 1.0e-6
+        max: 1.0e-3
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import yaml
+
+SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+# ------------------------------------------------------------ space expansion
+def _sample_param(spec: Dict[str, Any], rng: np.random.Generator) -> Any:
+    dist = spec.get("distribution", "choice")
+    if dist == "choice" or "values" in spec:
+        values = spec["values"]
+        return values[int(rng.integers(len(values)))]
+    if dist == "uniform":
+        return float(rng.uniform(spec["min"], spec["max"]))
+    if dist == "log_uniform":
+        lo, hi = np.log(spec["min"]), np.log(spec["max"])
+        return float(np.exp(rng.uniform(lo, hi)))
+    if dist == "int_uniform":
+        return int(rng.integers(spec["min"], spec["max"] + 1))
+    raise ValueError(f"unknown distribution {dist!r}")
+
+
+def expand_parameter_space(parameters: Dict[str, Dict[str, Any]],
+                           method: str = "grid",
+                           num_runs: int = 1,
+                           seed: int = 0) -> List[Dict[str, Any]]:
+    """Expand the sweep space into per-run {dotted_key: value} dicts."""
+    if not parameters:
+        return [{}]
+    if method == "grid":
+        keys = list(parameters)
+        value_lists = []
+        for key in keys:
+            spec = parameters[key]
+            if "values" not in spec:
+                raise ValueError(
+                    f"grid sweep needs 'values' for parameter {key!r}")
+            value_lists.append(spec["values"])
+        return [dict(zip(keys, combo))
+                for combo in itertools.product(*value_lists)]
+    if method == "random":
+        rng = np.random.default_rng(seed)
+        return [{key: _sample_param(spec, rng)
+                 for key, spec in parameters.items()}
+                for _ in range(num_runs)]
+    raise ValueError(f"unknown sweep method {method!r}")
+
+
+def _short_label(assignment: Dict[str, Any]) -> str:
+    parts = []
+    for key, val in assignment.items():
+        short_key = key.rsplit(".", 1)[-1]
+        # shorten dotted class paths only; numbers must stay intact
+        short_val = (val.rsplit(".", 1)[-1]
+                     if isinstance(val, str) else str(val))
+        parts.append(f"{short_key}={short_val}")
+    return ",".join(parts) if parts else "run"
+
+
+# ------------------------------------------------------------------ execution
+def run_sweep(sweep_cfg: Dict[str, Any],
+              sweep_dir: Path,
+              verbose: bool = True) -> List[Dict[str, Any]]:
+    """Launch all runs of the sweep; returns per-run records."""
+    assignments = expand_parameter_space(
+        sweep_cfg.get("parameters", {}),
+        method=sweep_cfg.get("method", "grid"),
+        num_runs=int(sweep_cfg.get("num_runs", 1)),
+        seed=int(sweep_cfg.get("seed", 0)))
+    program = os.path.join(SCRIPTS_DIR, sweep_cfg["program"])
+    max_parallel = int(sweep_cfg.get("max_parallel", 2))
+    stagger = float(sweep_cfg.get("stagger_seconds", 0.0))
+    fixed = list(sweep_cfg.get("overrides") or [])
+
+    records: List[Dict[str, Any]] = []
+    running: List[Dict[str, Any]] = []
+
+    def _reap(block: bool) -> None:
+        while running and (block or len(running) >= max_parallel):
+            for rec in list(running):
+                if rec["proc"].poll() is not None:
+                    rec["returncode"] = rec["proc"].returncode
+                    rec["log"].close()
+                    running.remove(rec)
+            if running and (block or len(running) >= max_parallel):
+                time.sleep(0.2)
+
+    for i, assignment in enumerate(assignments):
+        run_dir = sweep_dir / f"run_{i}"
+        run_dir.mkdir(parents=True, exist_ok=True)
+        with open(run_dir / "sweep_params.yaml", "w") as f:
+            yaml.safe_dump(assignment, f)
+
+        overrides = fixed + [f"{k}={v}" for k, v in assignment.items()]
+        overrides += [f"experiment.path_to_save={run_dir}"]
+        cmd = [sys.executable, program]
+        if sweep_cfg.get("config_path"):
+            cmd += ["--config-path",
+                    os.path.join(SCRIPTS_DIR, sweep_cfg["config_path"])]
+        if sweep_cfg.get("config_name"):
+            cmd += ["--config-name", sweep_cfg["config_name"]]
+        cmd += overrides
+
+        _reap(block=False)
+        log = open(run_dir / "stdout.log", "w")
+        proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                                cwd=SCRIPTS_DIR)
+        rec = {"index": i, "label": _short_label(assignment),
+               "dir": str(run_dir), "assignment": assignment,
+               "proc": proc, "log": log, "returncode": None}
+        records.append(rec)
+        running.append(rec)
+        if verbose:
+            print(f"[sweep] launched run_{i}: {rec['label']}", flush=True)
+        if stagger > 0:
+            time.sleep(stagger)
+
+    _reap(block=True)
+    for rec in records:
+        rec.pop("proc", None)
+        rec.pop("log", None)
+    return records
+
+
+def aggregate_sweep(sweep_dir: Path,
+                    records: List[Dict[str, Any]],
+                    metric_hint: str = "evaluation/episode_reward_mean"):
+    """Load every successful run's results and write the comparison table."""
+    from ddls_tpu.analysis import load_run, save_comparison_report
+
+    runs = []
+    for rec in records:
+        if rec.get("returncode") != 0:
+            print(f"[sweep] run_{rec['index']} failed "
+                  f"(rc={rec.get('returncode')}); see {rec['dir']}/stdout.log")
+            continue
+        try:
+            runs.append(load_run(rec["dir"], name=rec["label"]))
+        except FileNotFoundError as exc:
+            print(f"[sweep] run_{rec['index']}: {exc}")
+    if not runs:
+        return None
+    save_comparison_report(runs, sweep_dir / "analysis", metric=metric_hint)
+    from ddls_tpu.analysis import summary_table
+
+    table = summary_table(runs)
+    table.to_csv(sweep_dir / "sweep_summary.csv", index=False)
+    return table
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sweep-config", required=True,
+                        help="path to the sweep YAML")
+    parser.add_argument("--out", default=None,
+                        help="sweep output dir (default: "
+                             "<path_to_save>/<name>)")
+    args = parser.parse_args(argv)
+
+    with open(args.sweep_config) as f:
+        sweep_cfg = yaml.safe_load(f)
+    base = Path(args.out or os.path.join(
+        sweep_cfg.get("path_to_save", "/tmp/ddls_tpu/sweeps"),
+        sweep_cfg.get("name", "sweep")))
+    base.mkdir(parents=True, exist_ok=True)
+    with open(base / "sweep_config.yaml", "w") as f:
+        yaml.safe_dump(sweep_cfg, f)
+
+    records = run_sweep(sweep_cfg, base)
+    table = aggregate_sweep(base, records)
+    failed = [r for r in records if r.get("returncode") != 0]
+    if table is not None:
+        cols = [c for c in ("run", "episode_return", "blocking_rate",
+                            "acceptance_rate", "mean_job_completion_time")
+                if c in table.columns]
+        print(table[cols].to_string(index=False))
+        print(f"\nSweep artifacts under {base}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,17 +40,35 @@ def _heuristic_results(name, blocking_rate, jcts):
 
 
 def _training_results(n_epochs=4):
+    # exactly the shape Launcher.run logs: epoch dicts whose "evaluation"
+    # is the flat _episode_summary scalar dict (loops.py:120-143)
     return {
         "epochs": [
             {"episode_reward_mean": float(i),
              "evaluation": {"episode_reward_mean": float(i) + 0.5,
-                            "episode_stats": {
-                                "blocking_rate": 0.1,
-                                "acceptance_rate": 0.9,
-                                "job_completion_time": [5.0, 6.0],
-                                "job_completion_time_speedup": [3.0, 2.5]}},
+                            "episode_len_mean": 10.0,
+                            "custom_metrics/blocking_rate_mean": 0.1,
+                            "custom_metrics/acceptance_rate_mean": 0.9,
+                            "custom_metrics/mean_job_completion_time_mean":
+                                5.5},
              "epoch_time": 1.0}
             for i in range(n_epochs)
+        ]
+    }
+
+
+def _rl_eval_results():
+    # the shape scripts/test_from_config.py saves under "rl_eval"
+    return {
+        "rl_eval": [
+            {"episode": {"episode_return": 12.0, "episode_length": 9},
+             "episode_stats": {
+                 "blocking_rate": 0.25,
+                 "acceptance_rate": 0.75,
+                 "job_completion_time": [2.0, 4.0],
+                 "job_completion_time_speedup": [1.5, 2.5],
+                 "jobs_completed_num_nodes": [4, 6]},
+             "steps_log": {"step_time": [1.0, 2.0]}},
         ]
     }
 
@@ -79,10 +97,27 @@ def test_load_and_summary(tmp_path):
     row = table[table["run"] == "acceptable_jct"].iloc[0]
     assert row["blocking_rate"] == pytest.approx(0.05)
     assert row["mean_job_completion_time"] == pytest.approx(20.0)
-    # training run: final eval reward and eval episode stats used
+    # training run: final eval reward; episode stats re-mapped from the
+    # scalar custom_metrics the pipeline actually logs
     row = table[table["run"] == "ppo"].iloc[0]
     assert row["episode_return"] == pytest.approx(3.5)
     assert row["blocking_rate"] == pytest.approx(0.1)
+    assert row["mean_job_completion_time"] == pytest.approx(5.5)
+
+
+def test_rl_eval_run(tmp_path):
+    path = _save_run(tmp_path, "rl_eval_run", _rl_eval_results())
+    run = load_run(path)
+    assert run.kind == "rl_eval"
+    table = summary_table([run])
+    row = table.iloc[0]
+    assert row["episode_return"] == pytest.approx(12.0)
+    assert row["blocking_rate"] == pytest.approx(0.25)
+    assert row["mean_job_completion_time"] == pytest.approx(3.0)
+    jobs = completed_jobs_frame(run)
+    assert jobs["job_completion_time"].tolist() == [2.0, 4.0]
+    steps = steps_frame(run)
+    assert steps["step_time"].tolist() == [1.0, 2.0]
 
 
 def test_frames(tmp_path):
