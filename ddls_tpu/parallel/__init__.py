@@ -1,5 +1,10 @@
+from ddls_tpu.parallel.distributed import (distributed_info,
+                                           initialize_distributed,
+                                           is_primary,
+                                           shutdown_distributed)
 from ddls_tpu.parallel.mesh import (batch_sharding, make_mesh,
                                     replicated_sharding, shard_batch)
 
 __all__ = ["make_mesh", "batch_sharding", "replicated_sharding",
-           "shard_batch"]
+           "shard_batch", "initialize_distributed", "distributed_info",
+           "is_primary", "shutdown_distributed"]

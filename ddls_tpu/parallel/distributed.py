@@ -1,0 +1,118 @@
+"""Multi-host runtime: ``jax.distributed`` wiring for pod-slice scale-out.
+
+The reference scales across processes with Ray (module-level ``ray.init``,
+ramp_cluster_environment.py:29-36) and RLlib's worker actors; the TPU-native
+replacement is one JAX process per host joined into a single SPMD program
+(SURVEY.md §5.8). After :func:`initialize_distributed`, ``jax.devices()``
+returns the *global* device set, every mesh built by
+:func:`ddls_tpu.parallel.mesh.make_mesh` spans it, and XLA emits the
+cross-host collectives (ICI within a slice, DCN across slices) from sharding
+annotations alone.
+
+On a TPU pod slice ``jax.distributed.initialize()`` auto-discovers the
+coordinator from the TPU environment; elsewhere (multi-host CPU tests, GPU
+clusters) pass coordinator/process counts explicitly or via the
+``DDLS_TPU_COORDINATOR`` / ``DDLS_TPU_NUM_PROCESSES`` /
+``DDLS_TPU_PROCESS_ID`` environment variables so the same command line can
+be launched on every host.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+_initialized = False
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None,
+                           platform: Optional[str] = None,
+                           **kwargs) -> Dict[str, Any]:
+    """Join this process into the global JAX runtime; returns topology info.
+
+    Args resolve from explicit values first, then the ``DDLS_TPU_*``
+    environment, then JAX's own auto-detection (the TPU pod path, where no
+    arguments are needed). ``platform='cpu'`` pins the CPU backend and
+    selects gloo cross-process collectives -- the CI substitute for a pod
+    slice, mirroring the test strategy in SURVEY.md §4.
+    """
+    global _initialized
+    import jax
+
+    if platform == "cpu":
+        # must run before backend init; gloo provides the cross-process
+        # CPU collectives used by the virtual-pod tests
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    coordinator_address = (coordinator_address
+                           or os.environ.get("DDLS_TPU_COORDINATOR"))
+    if num_processes is None and os.environ.get("DDLS_TPU_NUM_PROCESSES"):
+        num_processes = int(os.environ["DDLS_TPU_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("DDLS_TPU_PROCESS_ID"):
+        process_id = int(os.environ["DDLS_TPU_PROCESS_ID"])
+
+    if not _initialized:
+        init_kwargs = dict(kwargs)
+        if coordinator_address is not None:
+            init_kwargs.update(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+        jax.distributed.initialize(**init_kwargs)
+        _initialized = True
+        if jax.process_count() > 1:
+            _warmup_collectives()
+    return distributed_info()
+
+
+def _warmup_collectives() -> None:
+    """Run one tiny all-device reduction immediately after init.
+
+    Cross-process collective contexts (gloo on CPU) are established lazily
+    on first use with a short handshake timeout; if hosts reach their first
+    real collective at different times (e.g. the primary writes an initial
+    checkpoint first), the handshake can expire. Doing it here, while every
+    process is in lockstep, makes later collectives timing-insensitive.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = np.asarray(jax.devices())
+    mesh = Mesh(devices.reshape(-1), ("all",))
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("all")),
+        np.ones((jax.local_device_count(),), np.float32),
+        (devices.size,))
+    y = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(x)
+    jax.block_until_ready(y)
+
+
+def distributed_info() -> Dict[str, Any]:
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "num_local_devices": jax.local_device_count(),
+        "num_global_devices": jax.device_count(),
+        "platform": jax.devices()[0].platform if jax.devices() else None,
+    }
+
+
+def is_primary() -> bool:
+    """True on the process that should own logging/checkpointing."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def shutdown_distributed() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False

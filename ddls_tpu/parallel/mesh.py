@@ -71,18 +71,39 @@ def shard_batch(mesh: Mesh, tree, batch_axis: int = 0,
                 axis_name: str = "dp"):
     """Place every leaf of ``tree`` on the mesh, sharded over its batch axis.
 
-    Leaves whose batch dimension is not divisible by the mesh axis size are
-    rejected (callers pad rollout batches to a multiple of the dp size).
+    Single-process: a plain ``device_put`` with the batch sharding. Multi-
+    process (after ``jax.distributed.initialize``): each process holds only
+    its locally collected rollouts, so leaves are treated as this process's
+    shard of the global batch and assembled with
+    ``jax.make_array_from_process_local_data`` -- the global batch is the
+    concatenation of every host's contribution along ``batch_axis``.
+
+    Leaves whose batch dimension is not divisible by the local mesh axis
+    size are rejected (callers pad rollout batches to a multiple of the dp
+    size).
     """
     sharding = batch_sharding(mesh, batch_axis, axis_name)
+    multiprocess = jax.process_count() > 1
     axis_size = mesh.shape[axis_name]
+    if multiprocess and axis_size % jax.process_count():
+        raise ValueError(
+            f"mesh axis {axis_name!r} of size {axis_size} cannot be evenly "
+            f"divided across {jax.process_count()} processes; size the "
+            "mesh as a multiple of the process count")
+    local_axis_size = (axis_size // jax.process_count()
+                       if multiprocess else axis_size)
 
     def put(x):
         x = np.asarray(x) if not isinstance(x, jax.Array) else x
-        if x.ndim <= batch_axis or x.shape[batch_axis] % axis_size:
+        if x.ndim <= batch_axis or x.shape[batch_axis] % local_axis_size:
             raise ValueError(
                 f"leaf shape {getattr(x, 'shape', None)} not shardable over "
-                f"{axis_size} devices on axis {batch_axis}")
+                f"{local_axis_size} local devices on axis {batch_axis}")
+        if multiprocess:
+            global_shape = list(x.shape)
+            global_shape[batch_axis] *= jax.process_count()
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x), tuple(global_shape))
         return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(put, tree)

@@ -31,7 +31,27 @@ class Checkpointer:
 
 
 def save_train_state(state, path: str) -> None:
-    """Orbax-save a learner TrainState (params/opt_state/counters)."""
+    """Save a learner TrainState (params/opt_state/counters).
+
+    Single-process: orbax PyTree checkpoint. Multi-process: only the
+    primary calls this, and orbax synchronises *all* processes on save
+    (even for host arrays), which would deadlock -- so the fully
+    replicated state is fetched to host numpy and written by this process
+    alone as a gzip pickle.
+    """
+    import jax
+    if jax.process_count() > 1:
+        import gzip
+        import pickle
+
+        state_np = jax.tree_util.tree_map(
+            lambda x: jax.device_get(x) if isinstance(x, jax.Array) else x,
+            state)
+        out = Path(path).absolute()
+        out.mkdir(parents=True, exist_ok=True)
+        with gzip.open(out / "state.pkl.gz", "wb") as f:
+            pickle.dump(state_np, f)
+        return
     import orbax.checkpoint as ocp
 
     ckptr = ocp.PyTreeCheckpointer()
@@ -42,8 +62,23 @@ def restore_train_state(path: str, target=None):
     """Restore a TrainState saved by :func:`save_train_state`.
 
     ``target`` (a template state with matching structure) restores typed
-    arrays; without it, orbax returns the raw pytree.
+    arrays; without it, the raw pytree is returned. Handles both backends
+    (orbax dir or the multi-process single-writer pickle).
     """
+    pickled = Path(path).absolute() / "state.pkl.gz"
+    if pickled.exists():
+        import gzip
+        import pickle
+
+        import jax
+
+        with gzip.open(pickled, "rb") as f:
+            loaded = pickle.load(f)
+        if target is not None:
+            return jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(target),
+                jax.tree_util.tree_leaves(loaded))
+        return loaded
     import orbax.checkpoint as ocp
 
     ckptr = ocp.PyTreeCheckpointer()

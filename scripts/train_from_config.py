@@ -72,17 +72,35 @@ def main(argv=None) -> int:
     cfg = load_config(args.config_path, args.config_name, args.overrides)
     experiment = cfg.get("experiment", {})
 
+    # opt-in multi-host: join the global JAX runtime before any backend
+    # init so the mesh spans every host's devices (SURVEY.md §5.8; replaces
+    # the reference's Ray worker topology)
+    distributed_cfg = dict(cfg.get("distributed") or {})
+    primary = True
+    if distributed_cfg.pop("enabled", False):
+        from ddls_tpu.parallel import initialize_distributed, is_primary
+
+        info = initialize_distributed(**distributed_cfg)
+        primary = is_primary()
+        print(f"Joined distributed runtime: process "
+              f"{info['process_index']}/{info['process_count']}, "
+              f"{info['num_local_devices']} local / "
+              f"{info['num_global_devices']} global devices")
+
     seed_everything(int(experiment.get("train_seed", 0)))
 
-    save_dir = unique_experiment_dir(
-        experiment.get("path_to_save", "/tmp/ddls_tpu/sims"),
-        experiment.get("name", "experiment"))
-    cfg.setdefault("experiment", {})["save_dir"] = save_dir
-    save_config(cfg, os.path.join(save_dir, "config.yaml"))
-    print(f"Experiment save dir: {save_dir}")
+    # only the primary process owns disk artifacts and external logging
+    save_dir = None
+    if primary:
+        save_dir = unique_experiment_dir(
+            experiment.get("path_to_save", "/tmp/ddls_tpu/sims"),
+            experiment.get("name", "experiment"))
+        cfg.setdefault("experiment", {})["save_dir"] = save_dir
+        save_config(cfg, os.path.join(save_dir, "config.yaml"))
+        print(f"Experiment save dir: {save_dir}")
 
     wandb = None
-    if cfg.get("wandb"):
+    if primary and cfg.get("wandb"):
         try:
             import wandb as wandb_module
 
@@ -100,13 +118,16 @@ def main(argv=None) -> int:
           f"{dict(epoch_loop.mesh.shape)}")
 
     launcher = Launcher(epoch_loop=epoch_loop, **cfg.get("launcher", {}))
-    logger = Logger(path_to_save=save_dir, **cfg.get("logger", {}))
-    checkpointer = Checkpointer(path_to_save=save_dir,
-                                **cfg.get("checkpointer", {}))
+    logger = (Logger(path_to_save=save_dir, **cfg.get("logger", {}))
+              if primary else None)
+    checkpointer = (Checkpointer(path_to_save=save_dir,
+                                 **cfg.get("checkpointer", {}))
+                    if primary else None)
 
     summary = launcher.run(logger=logger, checkpointer=checkpointer)
-    print(f"Best checkpoint: {summary['best_checkpoint']} "
-          f"({epoch_loop.metric}={summary['best_metric_value']})")
+    if primary:
+        print(f"Best checkpoint: {summary['best_checkpoint']} "
+              f"({epoch_loop.metric}={summary['best_metric_value']})")
     epoch_loop.close()
     return 0
 

@@ -1,0 +1,63 @@
+"""Worker process for the multi-host mesh test.
+
+Launched twice by tests/test_distributed.py with JAX_PLATFORMS=cpu and a
+2-device virtual host each, forming a 2-process x 2-device global mesh.
+Runs a learner-shaped update: params replicated, batch assembled from
+process-local shards, gradient all-reduced by XLA from the sharding
+annotations alone. Prints one machine-checkable line per assertion.
+"""
+import sys
+
+sys.path.insert(0, sys.argv[4] if len(sys.argv) > 4 else ".")
+
+from ddls_tpu.parallel import (distributed_info, initialize_distributed,
+                               is_primary, make_mesh, replicated_sharding,
+                               shard_batch)
+
+
+def main() -> int:
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    info = initialize_distributed(coordinator_address=coordinator,
+                                  num_processes=num_processes,
+                                  process_id=process_id,
+                                  platform="cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert info["process_count"] == num_processes, info
+    assert info["num_global_devices"] == 2 * num_processes, info
+    assert is_primary() == (process_id == 0)
+    print(f"TOPOLOGY process={info['process_index']} "
+          f"global_devices={info['num_global_devices']}", flush=True)
+
+    mesh = make_mesh()  # spans the global device set
+    assert mesh.devices.size == 2 * num_processes, mesh.shape
+
+    # global batch = concat of per-process shards; every process holds a
+    # distinct slice, so a wrong assembly changes the loss value
+    local_batch = np.arange(4, dtype=np.float32) + 4.0 * process_id
+    x = shard_batch(mesh, {"x": local_batch})["x"]
+    assert x.shape == (8,), x.shape
+
+    params = jax.device_put(jnp.float32(2.0), replicated_sharding(mesh))
+
+    @jax.jit
+    def update(w, batch):
+        # d/dw mean((w * b)^2) = mean(2 w b^2); XLA inserts the cross-host
+        # all-reduce for the mean over the sharded batch
+        grad = jax.grad(lambda w: jnp.mean((w * batch) ** 2))(w)
+        return w - 0.01 * grad
+
+    new_w = update(params, x)
+    # batch is globally 0..7 -> mean(b^2) = 17.5, grad = 2*2*17.5 = 70
+    expected = 2.0 - 0.01 * 70.0
+    got = float(jax.device_get(new_w))
+    assert abs(got - expected) < 1e-5, (got, expected)
+    print(f"UPDATE process={process_id} w={got:.6f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

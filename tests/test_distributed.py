@@ -1,0 +1,54 @@
+"""Multi-host mesh path: 2 CPU processes x 2 virtual devices each join one
+global mesh; shard_batch assembles per-process rollout shards and the jitted
+update all-reduces gradients across hosts (SURVEY.md §5.8 TPU-native
+equivalent of the reference's Ray worker topology)."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_distributed_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env() -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if k != "PALLAS_AXON_POOL_IPS"}  # keep the axon hook off jax init
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_two_process_global_mesh():
+    port = _free_port()
+    coordinator = f"localhost:{port}"
+    env = _worker_env()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, coordinator, "2", str(i), REPO],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(2)
+    ]
+    outputs = []
+    for proc in procs:
+        try:
+            out, _ = proc.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("distributed workers timed out")
+        outputs.append(out)
+    for i, (proc, out) in enumerate(zip(procs, outputs)):
+        assert proc.returncode == 0, f"worker {i} failed:\n{out}"
+        assert "global_devices=4" in out, out
+        assert f"UPDATE process={i} w=1.300000" in out, out
