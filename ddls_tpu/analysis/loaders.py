@@ -87,9 +87,12 @@ class RunResults:
             records = self.results["rl_eval"]
             return records[-1].get("episode_stats", {}) if records else {}
         if self.kind == "training":
+            # scan backwards for the first epoch with usable eval stats --
+            # a final epoch whose eval window finished no episode logs an
+            # empty evaluation and must not shadow earlier real data
             for epoch in reversed(self.results["epochs"]):
                 evaluation = epoch.get("evaluation", {})
-                if "episode_stats" in evaluation:
+                if evaluation.get("episode_stats"):
                     return evaluation["episode_stats"]
                 flat = _flatten_scalars(evaluation)
                 stats = {}

@@ -1,0 +1,137 @@
+"""Vanilla policy gradient (REINFORCE) learner on the mesh.
+
+Replaces the reference's RLlib ``PGTrainer``
+(scripts/ramp_job_partitioning_configs/algo/pg.yaml): loss is the plain
+score-function estimator ``-mean(logp * G)`` with discounted reward-to-go
+returns and no critic (the policy network's value head is simply unused),
+matching RLlib's PG semantics. One jitted update per collected batch,
+trajectories sharded over the mesh's ``dp`` axis.
+
+Episodes in this MDP terminate inside the rollout window (the env
+auto-resets), so reward-to-go is computed with the bootstrap cut at every
+``done`` and a zero tail for the truncated remainder -- the small
+truncation bias is inherent to PG without a value function.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddls_tpu.parallel.mesh import replicated_sharding, shard_batch
+
+
+@dataclasses.dataclass
+class PGConfig:
+    lr: float = 4e-4  # RLlib PG default
+    gamma: float = 0.99
+    grad_clip: Optional[float] = None
+    train_batch_size: int = 200
+
+
+class PGState(struct.PyTreeNode):
+    params: Any
+    opt_state: Any
+    step: jnp.ndarray
+
+    @classmethod
+    def create(cls, params, tx):
+        return cls(params=params, opt_state=tx.init(params),
+                   step=jnp.zeros((), jnp.int32))
+
+
+def reward_to_go(rewards: jnp.ndarray, dones: jnp.ndarray,
+                 gamma: float) -> jnp.ndarray:
+    """Discounted reward-to-go over [T, B], cut at episode boundaries."""
+    not_done = 1.0 - dones.astype(jnp.float32)
+
+    def scan_fn(carry, x):
+        r, nd = x
+        g = r + gamma * nd * carry
+        return g, g
+
+    _, returns = jax.lax.scan(scan_fn, jnp.zeros(rewards.shape[1]),
+                              (rewards, not_done), reverse=True)
+    return returns
+
+
+class PGLearner:
+    """Collector-compatible REINFORCE learner (same interface as
+    PPOLearner: ``sample_actions`` / ``shard_traj`` / ``train_step``)."""
+
+    def __init__(self, apply_fn: Callable, cfg: PGConfig, mesh):
+        self.apply_fn = apply_fn
+        self.cfg = cfg
+        self.mesh = mesh
+        chain = []
+        if cfg.grad_clip is not None:
+            chain.append(optax.clip_by_global_norm(cfg.grad_clip))
+        chain.append(optax.adam(cfg.lr))
+        self.tx = optax.chain(*chain)
+
+        self._replicated = replicated_sharding(mesh)
+        self._batch_time = NamedSharding(mesh, P(None, "dp"))
+        self._batch_only = NamedSharding(mesh, P("dp"))
+        self._jit_train_step = jax.jit(
+            self._train_step,
+            in_shardings=(self._replicated, self._batch_time,
+                          self._batch_only),
+            out_shardings=(self._replicated, self._replicated),
+            donate_argnums=(0,))
+        self._jit_sample = jax.jit(self._sample_actions)
+
+    def init_state(self, params) -> PGState:
+        params = jax.tree_util.tree_map(jnp.copy, params)
+        state = PGState.create(params, self.tx)
+        return jax.device_put(state, self._replicated)
+
+    def _sample_actions(self, params, obs, rng):
+        logits, values = self.apply_fn(params, obs)
+        actions = jax.random.categorical(rng, logits, axis=-1)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1), actions[:, None],
+            axis=-1)[:, 0]
+        return actions, logp, values
+
+    def sample_actions(self, params, obs, rng):
+        return self._jit_sample(params, obs, rng)
+
+    def _loss(self, params, traj, returns):
+        T, B = traj["rewards"].shape
+        flat_obs = jax.tree_util.tree_map(
+            lambda x: x.reshape((T * B,) + x.shape[2:]), traj["obs"])
+        logits, _ = self.apply_fn(params, flat_obs)
+        logp_all = jax.nn.log_softmax(logits.reshape(T, B, -1), axis=-1)
+        logp = jnp.take_along_axis(
+            logp_all, traj["actions"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        policy_loss = -jnp.mean(logp * returns)
+        metrics = {"policy_loss": policy_loss,
+                   "total_loss": policy_loss,
+                   "mean_return_to_go": jnp.mean(returns)}
+        return policy_loss, metrics
+
+    def _train_step(self, state: PGState, traj, last_values):
+        returns = reward_to_go(traj["rewards"], traj["dones"],
+                               self.cfg.gamma)
+        grad_fn = jax.value_and_grad(self._loss, has_aux=True)
+        (_, metrics), grads = grad_fn(state.params, traj, returns)
+        updates, opt_state = self.tx.update(grads, state.opt_state,
+                                            state.params)
+        params = optax.apply_updates(state.params, updates)
+        state = state.replace(params=params, opt_state=opt_state,
+                              step=state.step + 1)
+        return state, metrics
+
+    def train_step(self, state, traj, last_values, rng=None):
+        return self._jit_train_step(state, traj, last_values)
+
+    def shard_traj(self, traj: Dict[str, Any], last_values):
+        traj = shard_batch(self.mesh, traj, batch_axis=1)
+        last_values = shard_batch(self.mesh, last_values, batch_axis=0)
+        return traj, last_values

@@ -191,6 +191,13 @@ class RLEpochLoop:
 
         self._configure_algo(algo_config, num_envs, rollout_length)
 
+        # Multi-host: each process must collect DIFFERENT rollouts (its
+        # shard of the global batch), so env seeds and the action-sampling
+        # rng are offset by the process index; parameter init and the rng
+        # fed into the jitted sharded update must stay IDENTICAL on every
+        # process, or the nominally replicated state silently diverges.
+        self._collect_seed = self.seed + jax.process_index() * 100_003
+
         seed_everything(self.seed)
         if use_parallel_envs == "auto":
             # subprocess env workers only pay off with real cores to run on
@@ -198,12 +205,14 @@ class RLEpochLoop:
         if use_parallel_envs:
             self.vec_env = ParallelVectorEnv(
                 self.env_cls, self.env_config, self.num_envs,
-                seeds=[self.seed + i for i in range(self.num_envs)])
+                seeds=[self._collect_seed + i
+                       for i in range(self.num_envs)])
         else:
             self.vec_env = VectorEnv(
                 [lambda: self.env_cls(**self.env_config)
                  for _ in range(self.num_envs)],
-                seeds=[self.seed + i for i in range(self.num_envs)])
+                seeds=[self._collect_seed + i
+                       for i in range(self.num_envs)])
         self.vec_env.reset()
 
         template_env = getattr(self.vec_env, "envs", [None])[0]
@@ -224,6 +233,9 @@ class RLEpochLoop:
         self._build_learner()
 
         self._rng = jax.random.PRNGKey(self.seed + 1)
+        # offset keeps the collect stream distinct from the update stream
+        # even on process 0, where _collect_seed == seed
+        self._collect_rng = jax.random.PRNGKey(self._collect_seed + 7919)
         self.epoch_counter = 0
         self.total_env_steps = 0
         self.best_metric_value: Optional[float] = None
@@ -250,11 +262,15 @@ class RLEpochLoop:
     def _build_model(self, n_actions: int, model_config):
         return build_policy_from_model_config(n_actions, model_config)
 
-    def _build_learner(self) -> None:
+    def _make_learner(self):
         from ddls_tpu.rl.ppo import PPOLearner
+
+        return PPOLearner(self.apply_fn, self.ppo_cfg, self.mesh)
+
+    def _build_learner(self) -> None:
         from ddls_tpu.rl.rollout import RolloutCollector
 
-        self.learner = PPOLearner(self.apply_fn, self.ppo_cfg, self.mesh)
+        self.learner = self._make_learner()
         self.state = self.learner.init_state(self.params)
         self.collector = RolloutCollector(self.vec_env, self.learner,
                                           self.rollout_length)
@@ -262,9 +278,19 @@ class RLEpochLoop:
 
     # ----------------------------------------------------------------- epoch
     def _split_rng(self):
+        """Update rng: the same sequence on every process (fed into the
+        jitted sharded train step)."""
         import jax
 
         self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _split_collect_rng(self):
+        """Collection rng: process-distinct, so hosts sample different
+        actions and contribute genuinely different batch shards."""
+        import jax
+
+        self._collect_rng, sub = jax.random.split(self._collect_rng)
         return sub
 
     def run(self) -> Dict[str, Any]:
@@ -272,7 +298,8 @@ class RLEpochLoop:
         import jax
 
         start = time.time()
-        out = self.collector.collect(self.state.params, self._split_rng())
+        out = self.collector.collect(self.state.params,
+                                     self._split_collect_rng())
         straj, slv = self.learner.shard_traj(out["traj"], out["last_values"])
         self.state, metrics = self.learner.train_step(
             self.state, straj, slv, self._split_rng())
@@ -499,7 +526,7 @@ class ApexDQNEpochLoop(RLEpochLoop):
             batched = stack_obs(self.vec_env.obs)
             eps = per_worker_epsilons(B, self.total_env_steps, cfg)
             actions = np.asarray(self.learner.sample_actions(
-                self.state.params, batched, self._split_rng(), eps))
+                self.state.params, batched, self._split_collect_rng(), eps))
             prev_obs = list(self.vec_env.obs)
             _, rewards, dones = self.vec_env.step(actions)
             for i in range(B):
@@ -520,8 +547,14 @@ class ApexDQNEpochLoop(RLEpochLoop):
         # learning_starts counts cumulative sampled transitions (as RLlib
         # does), NOT current buffer occupancy — a capacity smaller than
         # learning_starts must still start training once enough steps were
-        # sampled
+        # sampled. The buffer-warm gate is a *deterministic lower bound* on
+        # replay size (sampled steps minus the worst-case n-step queue
+        # residue) rather than the actual per-host size: under multi-host
+        # training the jitted update is a cross-process collective, so
+        # every process must take this branch on the same epoch.
+        replay_lower_bound = self.total_env_steps - B * (cfg.n_step - 1)
         if (self.total_env_steps >= cfg.learning_starts
+                and replay_lower_bound >= cfg.train_batch_size
                 and self.replay.size >= cfg.train_batch_size):
             num_updates = max(1, int(round(
                 env_steps * cfg.training_intensity / cfg.train_batch_size)))
@@ -563,12 +596,162 @@ class ApexDQNEpochLoop(RLEpochLoop):
         return int(np.asarray(actions)[0])
 
 
+# RLlib IMPALA keys (algo/impala.yaml) -> ImpalaConfig fields; Ray queue /
+# aggregation plumbing keys are ignored
+_RLLIB_TO_IMPALA = {
+    "lr": "lr",
+    "gamma": "gamma",
+    "vtrace_clip_rho_threshold": "vtrace_clip_rho_threshold",
+    "vtrace_clip_pg_rho_threshold": "vtrace_clip_pg_rho_threshold",
+    "vtrace_drop_last_ts": "vtrace_drop_last_ts",
+    "vf_loss_coeff": "vf_loss_coeff",
+    "entropy_coeff": "entropy_coeff",
+    "grad_clip": "grad_clip",
+    "opt_type": "opt_type",
+    "decay": "decay",
+    "momentum": "momentum",
+    "epsilon": "epsilon",
+    "train_batch_size": "train_batch_size",
+}
+
+
+def impala_config_from_rllib(algo_config: Optional[dict]):
+    from ddls_tpu.rl.impala import ImpalaConfig
+
+    kwargs = {}
+    for src, dst in _RLLIB_TO_IMPALA.items():
+        if algo_config and algo_config.get(src) is not None:
+            kwargs[dst] = algo_config[src]
+    return ImpalaConfig(**kwargs)
+
+
+def pg_config_from_rllib(algo_config: Optional[dict]):
+    from ddls_tpu.rl.pg import PGConfig
+
+    kwargs = {}
+    for src, dst in (("lr", "lr"), ("gamma", "gamma"),
+                     ("grad_clip", "grad_clip"),
+                     ("train_batch_size", "train_batch_size")):
+        if algo_config and algo_config.get(src) is not None:
+            kwargs[dst] = algo_config[src]
+    return PGConfig(**kwargs)
+
+
+def es_config_from_rllib(algo_config: Optional[dict]):
+    from ddls_tpu.rl.es import ESConfig
+
+    kwargs = {}
+    for key in ("stepsize", "noise_stdev", "l2_coeff", "episodes_per_batch",
+                "report_length", "eval_prob", "action_noise_std",
+                "train_batch_size"):
+        if algo_config and algo_config.get(key) is not None:
+            kwargs[key] = algo_config[key]
+    return ESConfig(**kwargs)
+
+
+class ImpalaEpochLoop(RLEpochLoop):
+    """IMPALA epoch loop: the same vectorised collector as PPO (its one-
+    epoch policy lag is exactly what V-trace corrects) with a single jitted
+    V-trace update per batch (reference: algo/impala.yaml through
+    rllib_epoch_loop.py:34)."""
+
+    def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
+        self.impala_cfg = impala_config_from_rllib(algo_config)
+        self._size_rollouts(algo_config, num_envs, rollout_length,
+                            self.impala_cfg.train_batch_size)
+
+    def _make_learner(self):
+        from ddls_tpu.rl.impala import ImpalaLearner
+
+        return ImpalaLearner(self.apply_fn, self.impala_cfg, self.mesh)
+
+
+class PGEpochLoop(RLEpochLoop):
+    """Vanilla policy-gradient epoch loop (reference: algo/pg.yaml)."""
+
+    def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
+        self.pg_cfg = pg_config_from_rllib(algo_config)
+        self._size_rollouts(algo_config, num_envs, rollout_length,
+                            self.pg_cfg.train_batch_size)
+
+    def _make_learner(self):
+        from ddls_tpu.rl.pg import PGLearner
+
+        return PGLearner(self.apply_fn, self.pg_cfg, self.mesh)
+
+
+class ESEpochLoop(RLEpochLoop):
+    """Evolution-strategies epoch loop (reference: algo/es.yaml).
+
+    Each epoch: draw an antithetic population (one member per vectorised
+    env), evaluate every member's fitness over a fixed interaction window
+    with a single vmapped population forward per step, then apply the
+    rank-shaped ES update on device. ``num_envs`` is the population size
+    and must be even.
+    """
+
+    def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
+        self.es_cfg = es_config_from_rllib(algo_config)
+        self.num_envs = int(num_envs
+                            or (algo_config or {}).get("num_workers") or 10)
+        if self.num_envs % 2:
+            self.num_envs += 1  # antithetic pairs
+        self.rollout_length = int(
+            rollout_length
+            or max(self.es_cfg.train_batch_size // self.num_envs, 1))
+
+    def _build_learner(self) -> None:
+        from ddls_tpu.rl.es import ESLearner
+
+        self.learner = ESLearner(self.apply_fn, self.es_cfg, self.mesh,
+                                 population=self.num_envs)
+        self.state = self.learner.init_state(self.params)
+        self.collector = None
+
+    def run(self) -> Dict[str, Any]:
+        import jax
+
+        start = time.time()
+        # the perturbation rng feeds a state update, so it must be the
+        # SHARED stream: every host draws the identical population. Hosts
+        # then evaluate it on their own (differently seeded) envs and the
+        # per-member fitness is averaged across hosts — multi-host ES is
+        # fitness variance reduction, not population scale-out.
+        stacked, eps = self.learner.perturb(self.state.params,
+                                            self._split_rng())
+        fitness = self.learner.evaluate_population(
+            stacked, self.vec_env, window=self.rollout_length)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            fitness = np.mean(
+                multihost_utils.process_allgather(
+                    np.asarray(fitness, np.float32)), axis=0)
+        self.state, metrics = self.learner.update(self.state, eps, fitness)
+        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+
+        self.epoch_counter += 1
+        env_steps = self.rollout_length * self.num_envs
+        self.total_env_steps += env_steps
+        results: Dict[str, Any] = {
+            "epoch_counter": self.epoch_counter,
+            "env_steps_this_iter": env_steps,
+            "total_env_steps": self.total_env_steps,
+            "learner": metrics,
+        }
+        return self._finalize_results(
+            results, self.vec_env.drain_completed_episodes(), start)
+
+
 # algo_name (our algo/*.yaml) -> epoch-loop class; train_from_config
 # dispatches through this and hard-errors on unknown names so a mistyped
 # algo can never silently train PPO-with-defaults
 EPOCH_LOOPS = {
     "ppo": RLEpochLoop,
     "apex_dqn": ApexDQNEpochLoop,
+    "impala": ImpalaEpochLoop,
+    "pg": PGEpochLoop,
+    "es": ESEpochLoop,
 }
 
 

@@ -117,6 +117,7 @@ def run_sweep(sweep_cfg: Dict[str, Any],
     program = os.path.join(SCRIPTS_DIR, sweep_cfg["program"])
     max_parallel = int(sweep_cfg.get("max_parallel", 2))
     stagger = float(sweep_cfg.get("stagger_seconds", 0.0))
+    run_timeout = float(sweep_cfg.get("run_timeout_seconds", 3600))
     fixed = list(sweep_cfg.get("overrides") or [])
 
     records: List[Dict[str, Any]] = []
@@ -127,8 +128,16 @@ def run_sweep(sweep_cfg: Dict[str, Any],
             for rec in list(running):
                 if rec["proc"].poll() is not None:
                     rec["returncode"] = rec["proc"].returncode
-                    rec["log"].close()
-                    running.remove(rec)
+                elif time.time() - rec["started"] > run_timeout:
+                    rec["proc"].kill()
+                    rec["proc"].wait()
+                    rec["returncode"] = "timeout"
+                    print(f"[sweep] run_{rec['index']} killed after "
+                          f"{run_timeout:.0f}s timeout")
+                else:
+                    continue
+                rec["log"].close()
+                running.remove(rec)
             if running and (block or len(running) >= max_parallel):
                 time.sleep(0.2)
 
@@ -154,7 +163,8 @@ def run_sweep(sweep_cfg: Dict[str, Any],
                                 cwd=SCRIPTS_DIR)
         rec = {"index": i, "label": _short_label(assignment),
                "dir": str(run_dir), "assignment": assignment,
-               "proc": proc, "log": log, "returncode": None}
+               "proc": proc, "log": log, "returncode": None,
+               "started": time.time()}
         records.append(rec)
         running.append(rec)
         if verbose:
@@ -187,12 +197,16 @@ def aggregate_sweep(sweep_dir: Path,
             print(f"[sweep] run_{rec['index']}: {exc}")
     if not runs:
         return None
-    save_comparison_report(runs, sweep_dir / "analysis", metric=metric_hint)
-    from ddls_tpu.analysis import summary_table
+    artifacts = save_comparison_report(runs, sweep_dir / "analysis",
+                                       metric=metric_hint)
+    # the report already wrote the summary table; copy it up to the sweep
+    # root rather than recomputing it
+    import shutil
 
-    table = summary_table(runs)
-    table.to_csv(sweep_dir / "sweep_summary.csv", index=False)
-    return table
+    import pandas as pd
+
+    shutil.copyfile(artifacts["summary"], sweep_dir / "sweep_summary.csv")
+    return pd.read_csv(sweep_dir / "sweep_summary.csv")
 
 
 def main(argv=None) -> int:

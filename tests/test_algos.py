@@ -1,0 +1,284 @@
+"""IMPALA / PG / ES learners: V-trace and return math, jitted sharded
+updates, ES population mechanics, config translation, and epoch-loop smoke
+runs on the real env (reference counterpart: RLlib Impala/PG/ES trainers
+through scripts/ramp_job_partitioning_configs/algo/*.yaml)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddls_tpu.parallel.mesh import make_mesh
+from ddls_tpu.rl.es import ESConfig, ESLearner, centered_ranks
+from ddls_tpu.rl.impala import ImpalaConfig, ImpalaLearner, vtrace
+from ddls_tpu.rl.pg import PGConfig, PGLearner, reward_to_go
+
+
+# --------------------------------------------------------------- math units
+def test_vtrace_on_policy_hand_computed():
+    # T=2, B=1, gamma=0.5, on-policy (rho = c = 1)
+    logp = jnp.zeros((2, 1))
+    values = jnp.array([[1.0], [2.0]])
+    rewards = jnp.array([[1.0], [1.0]])
+    dones = jnp.zeros((2, 1))
+    last = jnp.array([3.0])
+    vs, adv = vtrace(logp, logp, rewards, values, dones, last, gamma=0.5)
+    # deltas: [1 + .5*2 - 1, 1 + .5*3 - 2] = [1, 0.5]
+    # vs   : [1 + 1 + .5*.5, 2 + .5] = [2.25, 2.5]
+    assert np.asarray(vs)[:, 0] == pytest.approx([2.25, 2.5])
+    # adv  : [1 + .5*2.5 - 1, 1 + .5*3 - 2] = [1.25, 0.5]
+    assert np.asarray(adv)[:, 0] == pytest.approx([1.25, 0.5])
+
+
+def test_vtrace_clips_importance_weights():
+    behavior = jnp.zeros((2, 1))
+    target = jnp.full((2, 1), np.log(4.0))  # rho = 4, clipped to 1
+    values = jnp.array([[1.0], [2.0]])
+    rewards = jnp.array([[1.0], [1.0]])
+    dones = jnp.zeros((2, 1))
+    last = jnp.array([3.0])
+    vs_clip, adv_clip = vtrace(behavior, target, rewards, values, dones,
+                               last, gamma=0.5)
+    vs_on, adv_on = vtrace(behavior, behavior, rewards, values, dones,
+                           last, gamma=0.5)
+    # with clip thresholds 1.0 the clipped off-policy result equals the
+    # on-policy one
+    assert np.asarray(vs_clip) == pytest.approx(np.asarray(vs_on))
+    assert np.asarray(adv_clip) == pytest.approx(np.asarray(adv_on))
+
+
+def test_vtrace_done_cuts_bootstrap():
+    logp = jnp.zeros((2, 1))
+    values = jnp.array([[1.0], [2.0]])
+    rewards = jnp.array([[1.0], [1.0]])
+    dones = jnp.array([[1.0], [0.0]])  # episode ends at t=0
+    last = jnp.array([3.0])
+    vs, _ = vtrace(logp, logp, rewards, values, dones, last, gamma=0.5)
+    # t=0: delta = 1 - 1 = 0 and no propagation from t=1 -> vs[0] = 1
+    assert float(vs[0, 0]) == pytest.approx(1.0)
+
+
+def test_reward_to_go():
+    rewards = jnp.array([[1.0], [2.0], [4.0]])
+    dones = jnp.zeros((3, 1))
+    g = reward_to_go(rewards, dones, gamma=0.5)
+    assert np.asarray(g)[:, 0] == pytest.approx([3.0, 4.0, 4.0])
+    # done at t=1 cuts the tail out of t<=1 returns
+    g2 = reward_to_go(rewards, jnp.array([[0.0], [1.0], [0.0]]), 0.5)
+    assert np.asarray(g2)[:, 0] == pytest.approx([2.0, 2.0, 4.0])
+
+
+def test_centered_ranks():
+    w = centered_ranks(jnp.array([3.0, 1.0, 2.0]))
+    assert np.asarray(w) == pytest.approx([0.5, -0.5, 0.0])
+
+
+# ------------------------------------------------------------ tiny learners
+def _mlp_apply(params, obs):
+    h = jnp.tanh(obs["x"] @ params["w1"])
+    return h @ params["w2"], (h @ params["w3"])[:, 0]
+
+
+def _mlp_params(rng, n_actions=5):
+    return {"w1": rng.randn(4, 8).astype(np.float32),
+            "w2": rng.randn(8, n_actions).astype(np.float32),
+            "w3": rng.randn(8, 1).astype(np.float32)}
+
+
+def _traj(rng, T=4, B=8, n_actions=5):
+    return {
+        "obs": {"x": rng.rand(T, B, 4).astype(np.float32)},
+        "actions": rng.randint(0, n_actions, (T, B)).astype(np.int32),
+        "logp": -np.abs(rng.rand(T, B)).astype(np.float32),
+        "values": rng.randn(T, B).astype(np.float32),
+        "rewards": rng.randn(T, B).astype(np.float32),
+        "dones": (rng.rand(T, B) < 0.1),
+    }
+
+
+def _params_moved(before, after):
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        jax.device_get(before), jax.device_get(after))
+    return max(jax.tree_util.tree_leaves(diffs))
+
+
+def test_impala_learner_update():
+    mesh = make_mesh(8)
+    learner = ImpalaLearner(_mlp_apply, ImpalaConfig(lr=1e-2), mesh)
+    rng = np.random.RandomState(0)
+    params = _mlp_params(rng)
+    state = learner.init_state(params)
+    traj, last = learner.shard_traj(_traj(rng),
+                                    rng.randn(8).astype(np.float32))
+    state2, metrics = learner.train_step(state, traj, last)
+    metrics = jax.device_get(metrics)
+    for key in ("policy_loss", "vf_loss", "entropy", "total_loss",
+                "mean_rho"):
+        assert np.isfinite(float(metrics[key])), key
+    assert _params_moved(params, state2.params) > 0
+    assert int(state2.step) == 1
+
+
+def test_pg_learner_update():
+    mesh = make_mesh(8)
+    learner = PGLearner(_mlp_apply, PGConfig(lr=1e-2), mesh)
+    rng = np.random.RandomState(0)
+    params = _mlp_params(rng)
+    state = learner.init_state(params)
+    traj, last = learner.shard_traj(_traj(rng),
+                                    np.zeros(8, np.float32))
+    state2, metrics = learner.train_step(state, traj, last)
+    assert np.isfinite(float(jax.device_get(metrics)["policy_loss"]))
+    assert _params_moved(params, state2.params) > 0
+
+
+def test_es_antithetic_perturbations():
+    mesh = make_mesh(8)
+    learner = ESLearner(_mlp_apply, ESConfig(noise_stdev=0.1), mesh,
+                        population=8)
+    rng = np.random.RandomState(0)
+    params = _mlp_params(rng)
+    stacked, eps = learner.perturb(params, jax.random.PRNGKey(0))
+    w1 = np.asarray(stacked["w1"])
+    assert w1.shape == (8, 4, 8)
+    # antithetic: member i and i + P/2 mirror around the mean params
+    for i in range(4):
+        assert w1[i] + w1[i + 4] == pytest.approx(
+            2 * params["w1"], abs=1e-5)
+
+
+def test_es_update_optimises_quadratic():
+    """ES on a pure optimisation problem: fitness = -||theta||^2 must
+    drive the parameters toward zero without any gradients."""
+    mesh = make_mesh(8)
+    learner = ESLearner(_mlp_apply, ESConfig(stepsize=0.05, noise_stdev=0.1,
+                                             l2_coeff=0.0), mesh,
+                        population=32)
+    rng_np = np.random.RandomState(0)
+    params = {"w": rng_np.randn(6).astype(np.float32)}
+    state = learner.init_state(params)
+    rng = jax.random.PRNGKey(1)
+    norm0 = float(np.linalg.norm(np.asarray(state.params["w"])))
+    for _ in range(60):
+        rng, sub = jax.random.split(rng)
+        stacked, eps = learner.perturb(state.params, sub)
+        fitness = -np.sum(np.asarray(stacked["w"]) ** 2, axis=1)
+        state, metrics = learner.update(state, eps, fitness)
+    norm_end = float(np.linalg.norm(np.asarray(state.params["w"])))
+    assert norm_end < 0.5 * norm0
+    assert np.isfinite(float(jax.device_get(metrics)["fitness_mean"]))
+
+
+def test_es_rejects_odd_population():
+    with pytest.raises(ValueError, match="even"):
+        ESLearner(_mlp_apply, ESConfig(), make_mesh(8), population=3)
+
+
+# ------------------------------------------------------- config translation
+def test_impala_config_translation():
+    from ddls_tpu.train.loops import impala_config_from_rllib
+
+    cfg = impala_config_from_rllib({
+        "vtrace_clip_rho_threshold": 1.0, "grad_clip": 40.0,
+        "opt_type": "adam", "vf_loss_coeff": 0.5, "entropy_coeff": 0.01,
+        "learner_queue_size": 16,  # ray-only, ignored
+        "num_workers": 32})
+    assert cfg.grad_clip == 40.0
+    assert cfg.entropy_coeff == 0.01
+    assert cfg.opt_type == "adam"
+
+
+def test_es_config_translation():
+    from ddls_tpu.train.loops import es_config_from_rllib
+
+    cfg = es_config_from_rllib({"noise_stdev": 0.02, "stepsize": 0.01,
+                                "l2_coeff": 0.005, "noise_size": 250000000})
+    assert cfg.noise_stdev == 0.02
+    assert cfg.stepsize == 0.01
+
+
+# ------------------------------------------------------- epoch loop smoke
+def _env_config(dataset_dir):
+    return dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 100.0},
+            "replication_factor": 4,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 2},
+        max_partitions_per_op=4,
+        reward_function="job_acceptance",
+        max_simulation_run_time=5e4,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+
+
+_TINY_MODEL = {"fcnet_hiddens": [16],
+               "custom_model_config": {"out_features_msg": 4,
+                                       "out_features_hidden": 8,
+                                       "out_features_node": 4,
+                                       "out_features_graph": 4}}
+
+
+@pytest.mark.parametrize("algo,algo_config", [
+    ("impala", {"lr": 1e-3, "grad_clip": 40.0, "train_batch_size": 20,
+                "num_workers": 2}),
+    ("pg", {"lr": 1e-3, "gamma": 0.99, "train_batch_size": 20,
+            "num_workers": 2}),
+])
+def test_actor_critic_loops_train_on_env(algo, algo_config, dataset_dir):
+    from ddls_tpu.train import make_epoch_loop
+
+    loop = make_epoch_loop(
+        algo,
+        path_to_env_cls=("ddls_tpu.envs.partitioning_env."
+                         "RampJobPartitioningEnvironment"),
+        env_config=_env_config(dataset_dir),
+        model=_TINY_MODEL,
+        algo_config=algo_config,
+        num_envs=2, rollout_length=10, n_devices=2,
+        use_parallel_envs=False, evaluation_interval=2,
+        evaluation_duration=1, seed=0)
+    before = jax.device_get(loop.state.params)
+    r1 = loop.run()
+    assert r1["env_steps_this_iter"] == 20
+    assert np.isfinite(r1["learner"]["total_loss"])
+    r2 = loop.run()
+    assert "evaluation" in r2
+    assert _params_moved(before, loop.state.params) > 0
+    loop.close()
+
+
+def test_es_loop_trains_on_env(dataset_dir):
+    from ddls_tpu.train import make_epoch_loop
+
+    loop = make_epoch_loop(
+        "es",
+        path_to_env_cls=("ddls_tpu.envs.partitioning_env."
+                         "RampJobPartitioningEnvironment"),
+        env_config=_env_config(dataset_dir),
+        model=_TINY_MODEL,
+        algo_config={"stepsize": 0.01, "noise_stdev": 0.02,
+                     "num_workers": 2},
+        num_envs=2, rollout_length=8, n_devices=8,
+        use_parallel_envs=False, evaluation_interval=2,
+        evaluation_duration=1, seed=0)
+    assert loop.num_envs == 2  # population
+    before = jax.device_get(loop.state.params)
+    r1 = loop.run()
+    assert r1["env_steps_this_iter"] == 16
+    assert np.isfinite(r1["learner"]["fitness_mean"])
+    r2 = loop.run()
+    assert "evaluation" in r2
+    assert _params_moved(before, loop.state.params) > 0
+    loop.close()

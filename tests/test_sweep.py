@@ -56,6 +56,7 @@ def test_heuristic_sweep_end_to_end(tmp_path):
         "method": "grid",
         "max_parallel": 2,
         "stagger_seconds": 0.0,
+        "run_timeout_seconds": 240,
         "overrides": [
             "experiment.seed=0",
             "eval_loop.env.jobs_config.replication_factor=2",
