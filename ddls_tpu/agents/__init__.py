@@ -4,9 +4,19 @@ from ddls_tpu.agents.partitioners import (RandomOpPartitioner,
 from ddls_tpu.agents.placers import (FirstFitDepPlacer, RampFirstFitOpPlacer,
                                      RandomOpPlacer)
 from ddls_tpu.agents.schedulers import SRPTDepScheduler, SRPTOpScheduler
+from ddls_tpu.agents.managers import (AllReduceJobCommunicator,
+                                      FIFOJobScheduler, JobScheduler,
+                                      Placer, RandomJobPlacer,
+                                      RandomJobPartitioner, RandomJobScheduler,
+                                      SRPTJobPrioritiser,
+                                      SRPTJobScheduler)
 
 __all__ = [
     "SipMlOpPartitioner", "RandomOpPartitioner", "sip_ml_num_partitions",
     "RampFirstFitOpPlacer", "RandomOpPlacer", "FirstFitDepPlacer",
     "SRPTOpScheduler", "SRPTDepScheduler",
+    "Placer", "JobScheduler", "RandomJobPlacer", "FIFOJobScheduler",
+    "SRPTJobScheduler", "RandomJobScheduler", "SRPTJobPrioritiser",
+    "RandomJobPartitioner",
+    "AllReduceJobCommunicator",
 ]

@@ -1,0 +1,201 @@
+"""Legacy manager-style control-plane agents for the dynamic cluster.
+
+Counterpart of the reference's ``ddls/managers/`` package: abstract
+Placer / JobScheduler / JobPartitioner / JobPrioritiser / JobCommunicator
+interfaces plus the concrete agents the legacy ``scripts/run_sim.py`` demo
+drives (RandomJobPlacer, FIFO/SRPT/Random job schedulers; reference:
+managers/placers/random_job_placer.py:20,
+managers/schedulers/{fifo,srpt,random}_job_scheduler.py).
+
+These operate on the legacy :class:`~ddls_tpu.sim.legacy_cluster.
+ClusterEnvironment` action dict shape::
+
+    placement = placer.get_placement(cluster)
+    schedule  = scheduler.get_schedule(new_placements=placement, cluster=cluster)
+    cluster.step({"job_placement": placement, "job_schedule": schedule})
+"""
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import Dict, Optional
+
+from ddls_tpu.sim.partition import partition_graph
+
+
+class Placer:
+    """(reference: managers/placers/placer.py:3)"""
+
+    def get_placement(self, cluster) -> Dict[int, Dict[str, str]]:
+        raise NotImplementedError
+
+
+class JobScheduler:
+    """(reference: managers/schedulers/job_scheduler.py)"""
+
+    def get_schedule(self, new_placements: dict, cluster) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def _iter_placed_ops(new_placements: dict, cluster):
+        """Yield (worker_id, job, op_id) for every op of every placement
+        currently relevant: the new placements plus jobs already running."""
+        placements = dict(cluster.job_op_placement)
+        placements.update(new_placements)
+        for job_id, op_to_worker in placements.items():
+            job = cluster.job_queue.jobs.get(job_id)
+            if job is None:
+                job_idx = cluster.job_id_to_job_idx.get(job_id)
+                job = cluster.jobs_running.get(job_idx)
+            if job is None:
+                continue
+            for op_id, worker_id in op_to_worker.items():
+                yield worker_id, job, op_id
+
+
+class JobPartitioner:
+    """(reference: managers/partitioners/job_partitioner.py)"""
+
+    def get_partitioned_graph(self, graph):
+        raise NotImplementedError
+
+
+class JobPrioritiser:
+    """(reference: managers/prioritisers/job_prioritiser.py)"""
+
+    def get_priorities(self, cluster) -> Dict[int, int]:
+        raise NotImplementedError
+
+
+class JobCommunicator:
+    """(reference: managers/communicators/job_communicator.py)"""
+
+    def communicate(self, cluster) -> None:
+        raise NotImplementedError
+
+
+class RandomJobPlacer(Placer):
+    """Random valid (memory-feasible) worker per op; a job with any
+    unplaceable op is left out of the placement entirely
+    (reference: managers/placers/random_job_placer.py:20-60)."""
+
+    def get_placement(self, cluster) -> Dict[int, Dict[str, str]]:
+        available = {worker_id: worker.memory_free
+                     for worker_id, worker in cluster.topology.workers.items()}
+        placement: Dict[int, Dict[str, str]] = {}
+        for job in cluster.job_queue.jobs.values():
+            op_to_worker: Dict[str, str] = {}
+            feasible = True
+            taken: Dict[str, float] = defaultdict(float)
+            for op_id in job.graph.op_ids:
+                mem = job.graph.memory_cost(op_id)
+                valid = [w for w, free in available.items()
+                         if free - taken[w] >= mem]
+                if not valid:
+                    feasible = False
+                    break
+                worker_id = random.choice(valid)
+                taken[worker_id] += mem
+                op_to_worker[op_id] = worker_id
+            if feasible:
+                for w, used in taken.items():
+                    available[w] -= used
+                placement[job.job_id] = op_to_worker
+        return placement
+
+
+class FIFOJobScheduler(JobScheduler):
+    """Earlier-arrived jobs get higher priority on every worker; ops within
+    a job are tie-broken by op id (reference:
+    managers/schedulers/fifo_job_scheduler.py)."""
+
+    def get_schedule(self, new_placements: dict, cluster) -> dict:
+        worker_rows = defaultdict(list)
+        for worker_id, job, op_id in self._iter_placed_ops(new_placements,
+                                                           cluster):
+            worker_rows[worker_id].append((job, op_id))
+        schedule: dict = defaultdict(lambda: defaultdict(dict))
+        for worker_id, rows in worker_rows.items():
+            rows.sort(key=lambda r: (r[0].details["time_arrived"],
+                                     r[0].job_id, str(r[1])))
+            for pri, (job, op_id) in enumerate(reversed(rows)):
+                schedule[worker_id][job.job_id][op_id] = pri
+        return schedule
+
+
+class SRPTJobScheduler(JobScheduler):
+    """Shortest-remaining-processing-time: on each worker the op belonging
+    to the job with the least remaining sequential compute gets the highest
+    priority (reference: managers/schedulers/srpt_job_scheduler.py:9)."""
+
+    def get_schedule(self, new_placements: dict, cluster) -> dict:
+        worker_rows = defaultdict(list)
+        for worker_id, job, op_id in self._iter_placed_ops(new_placements,
+                                                           cluster):
+            remaining_steps = max(
+                job.num_training_steps - job.training_step_counter, 1)
+            job_remaining = (job.immutable["job_sequential_completion_time"]
+                             * remaining_steps / job.num_training_steps)
+            worker_rows[worker_id].append((job_remaining, job, op_id))
+        schedule: dict = defaultdict(lambda: defaultdict(dict))
+        for worker_id, rows in worker_rows.items():
+            # longest remaining first -> lowest priority number
+            rows.sort(key=lambda r: (-r[0], r[1].job_id, str(r[2])))
+            for pri, (_, job, op_id) in enumerate(rows):
+                schedule[worker_id][job.job_id][op_id] = pri
+        return schedule
+
+
+class RandomJobScheduler(JobScheduler):
+    """(reference: managers/schedulers/random_job_scheduler.py)"""
+
+    def get_schedule(self, new_placements: dict, cluster) -> dict:
+        worker_rows = defaultdict(list)
+        for worker_id, job, op_id in self._iter_placed_ops(new_placements,
+                                                           cluster):
+            worker_rows[worker_id].append((job, op_id))
+        schedule: dict = defaultdict(lambda: defaultdict(dict))
+        for worker_id, rows in worker_rows.items():
+            pris = list(range(len(rows)))
+            random.shuffle(pris)
+            for pri, (job, op_id) in zip(pris, rows):
+                schedule[worker_id][job.job_id][op_id] = pri
+        return schedule
+
+
+class RandomJobPartitioner(JobPartitioner):
+    """Random even split degree per forward op (reference:
+    managers/partitioners/random_job_partitioner.py)."""
+
+    def __init__(self, max_partitions_per_op: int = 2):
+        self.max_partitions_per_op = max_partitions_per_op
+
+    def get_partitioned_graph(self, graph):
+        action: Dict[str, int] = {}
+        for op_id in graph.forward_op_ids():
+            degrees = [1] + [n for n in range(2, self.max_partitions_per_op + 1, 2)]
+            action[str(int(op_id))] = random.choice(degrees)
+        return partition_graph(graph, action)
+
+
+class SRPTJobPrioritiser(JobPrioritiser):
+    """Queued jobs ranked by sequential completion time, shortest first
+    (reference: managers/prioritisers/srpt_job_prioritiser.py)."""
+
+    def get_priorities(self, cluster) -> Dict[int, int]:
+        jobs = sorted(cluster.job_queue.jobs.values(),
+                      key=lambda j: j.immutable[
+                          "job_sequential_completion_time"])
+        return {job.job_id: pri
+                for pri, job in enumerate(reversed(jobs))}
+
+
+class AllReduceJobCommunicator(JobCommunicator):
+    """Parity stub: unimplemented in the reference too
+    (managers/communicators/all_reduce_job_communicator.py:4)."""
+
+    def communicate(self, cluster) -> None:
+        raise NotImplementedError(
+            "AllReduceJobCommunicator is a stub in the reference; the RAMP "
+            "path prices collectives analytically instead "
+            "(ddls_tpu.sim.comm_model)")

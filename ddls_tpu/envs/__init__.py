@@ -1,8 +1,10 @@
 from ddls_tpu.envs.partitioning_env import RampJobPartitioningEnvironment
 from ddls_tpu.envs.placement_shaping_env import (
     RampJobPlacementShapingEnvironment)
+from ddls_tpu.envs.job_placing_env import JobPlacingAllNodesEnvironment
 from ddls_tpu.envs import baselines, rewards, spaces
 
 __all__ = ["RampJobPartitioningEnvironment",
-           "RampJobPlacementShapingEnvironment", "baselines", "rewards",
+           "RampJobPlacementShapingEnvironment",
+           "JobPlacingAllNodesEnvironment", "baselines", "rewards",
            "spaces"]

@@ -1,0 +1,322 @@
+"""Legacy job-placing MDP on the dynamic Torus cluster.
+
+Counterpart of the reference's ``JobPlacingAllNodesEnvironment``
+(ddls/environments/job_placing/job_placing_all_nodes_environment.py:19):
+the agent chooses HOW MANY cluster workers to use for the queued job
+(Discrete(num_workers), action ``a`` -> ``a + 1`` workers; or a float
+fraction in continuous mode); workers are then selected at random and the
+job's ops are allocated sequentially (round-robin) or randomly across
+them. The cluster is the legacy dynamic-tick simulator, so many jobs share
+workers and communication is free.
+
+Rewards (reference: environments/job_placing/rewards/):
+
+* ``worker_compute_utilisation``  -- the step's mean active-worker frac;
+* ``mean_job_completion_time``    -- -log(mean JCT completed this step);
+* ``total_job_completion_time``   -- -sum of JCTs completed this step.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+from ddls_tpu.envs.spaces import Box, Dict as DictSpace, Discrete
+from ddls_tpu.sim.legacy_cluster import ClusterEnvironment
+
+
+from ddls_tpu.envs.rewards import _log_transform as _transform_with_log
+
+
+class WorkerComputeUtilisation:
+    def reset(self, cluster) -> None:
+        pass
+
+    def extract(self, cluster, done: bool) -> float:
+        return float(cluster.step_stats["mean_worker_compute_utilisation"])
+
+
+class MeanJobCompletionTime:
+    def __init__(self, sign: int = -1, transform_with_log: bool = True):
+        self.sign = sign
+        self.transform_with_log = transform_with_log
+
+    def reset(self, cluster) -> None:
+        pass
+
+    def extract(self, cluster, done: bool) -> float:
+        n = int(cluster.step_stats["num_jobs_completed"])
+        if n == 0:
+            return 0.0
+        reward = float(np.mean(cluster.sim_log["job_completion_time"][-n:]))
+        if self.transform_with_log:
+            reward = _transform_with_log(reward)
+        return self.sign * reward
+
+
+class TotalJobCompletionTime:
+    def __init__(self, sign: int = -1):
+        self.sign = sign
+
+    def reset(self, cluster) -> None:
+        pass
+
+    def extract(self, cluster, done: bool) -> float:
+        n = int(cluster.step_stats["num_jobs_completed"])
+        if n == 0:
+            return 0.0
+        return self.sign * float(
+            np.sum(cluster.sim_log["job_completion_time"][-n:]))
+
+
+REWARD_FUNCTIONS = {
+    "worker_compute_utilisation": WorkerComputeUtilisation,
+    "mean_job_completion_time": MeanJobCompletionTime,
+    "total_job_completion_time": TotalJobCompletionTime,
+}
+
+
+class JobPlacingAllNodesObservation:
+    """Padded array encoding of the job waiting to be placed plus cluster
+    occupancy (reference: observations/
+    job_placing_all_nodes_observation.py:13, simplified to the features the
+    GNN policy consumes: per-op costs, edge sizes, job+cluster scalars)."""
+
+    def __init__(self, pad_obs_kwargs: Optional[dict] = None):
+        self.pad_obs_kwargs = pad_obs_kwargs or {}
+
+    def reset(self, env) -> None:
+        self.max_nodes = int(self.pad_obs_kwargs.get("max_nodes", 64))
+        self.max_edges = int(self.pad_obs_kwargs.get(
+            "max_edges", self.max_nodes * (self.max_nodes - 1)))
+        n_actions = env.action_space.n
+        self.observation_space = DictSpace({
+            "node_features": Box(0.0, np.inf, (self.max_nodes, 2)),
+            "edge_features": Box(0.0, np.inf, (self.max_edges, 1)),
+            "graph_features": Box(-np.inf, np.inf, (4,)),
+            "edges_src": Box(0, self.max_nodes, (self.max_edges,),
+                             dtype=np.int32),
+            "edges_dst": Box(0, self.max_nodes, (self.max_edges,),
+                             dtype=np.int32),
+            "node_split": Box(0, self.max_nodes, (1,), dtype=np.int32),
+            "edge_split": Box(0, self.max_edges, (1,), dtype=np.int32),
+            "action_set": Box(0, n_actions, (n_actions,), dtype=np.int32),
+            "action_mask": Box(0, 1, (n_actions,), dtype=np.int32),
+        })
+
+    def extract(self, env, done: bool) -> Dict[str, np.ndarray]:
+        job = env._job_to_place()
+        cluster = env.cluster
+        n_actions = env.action_space.n
+
+        nodes = np.zeros((self.max_nodes, 2), np.float32)
+        edges = np.zeros((self.max_edges, 1), np.float32)
+        src = np.zeros(self.max_edges, np.int32)
+        dst = np.zeros(self.max_edges, np.int32)
+        n_ops = n_deps = 0
+        if job is not None:
+            arrays = job.graph.finalize()
+            n_ops = min(job.graph.n_ops, self.max_nodes)
+            n_deps = min(job.graph.n_deps, self.max_edges)
+            compute = arrays["compute"][:n_ops]
+            memory = arrays["memory"][:n_ops]
+            nodes[:n_ops, 0] = compute / max(compute.max(), 1e-9)
+            nodes[:n_ops, 1] = memory / max(memory.max(), 1e-9)
+            sizes = arrays["edge_size"][:n_deps]
+            edges[:n_deps, 0] = sizes / max(sizes.max(), 1e-9)
+            src[:n_deps] = arrays["edge_src"][:n_deps]
+            dst[:n_deps] = arrays["edge_dst"][:n_deps]
+
+        free = [w.memory_free / max(w.memory_capacity, 1)
+                for w in cluster.topology.workers.values()]
+        graph = np.asarray([
+            n_ops / self.max_nodes,
+            (math.log10(job.immutable["job_sequential_completion_time"] + 1)
+             if job is not None else 0.0),
+            float(np.mean(free)),
+            len(cluster.jobs_running) / max(cluster.topology.num_workers, 1),
+        ], np.float32)
+
+        return {
+            "node_features": nodes,
+            "edge_features": edges,
+            "graph_features": graph,
+            "edges_src": src,
+            "edges_dst": dst,
+            "node_split": np.asarray([n_ops], np.int32),
+            "edge_split": np.asarray([n_deps], np.int32),
+            "action_set": np.arange(n_actions, dtype=np.int32),
+            "action_mask": env._action_mask(job),
+        }
+
+
+class JobPlacingAllNodesEnvironment:
+    """reset/step protocol env (same shape as the RAMP envs)."""
+
+    def __init__(self,
+                 topology_config: dict,
+                 node_config: dict,
+                 jobs_config: dict,
+                 continuous_action_mode: bool = False,
+                 worker_selection: str = "random",
+                 op_allocation: str = "sequential",
+                 job_scheduler: str = "srpt_job_scheduler",
+                 pad_obs_kwargs: Optional[dict] = None,
+                 observation_function: str = "default",
+                 information_function: str = "default",
+                 reward_function: str = "mean_job_completion_time",
+                 reward_function_kwargs: Optional[dict] = None,
+                 max_cluster_simulation_run_time: float = float("inf"),
+                 job_queue_capacity: int = 10,
+                 name: str = "job_placing_all_nodes",
+                 path_to_save: Optional[str] = None,
+                 save_cluster_data: bool = False,
+                 save_freq: int = 1,
+                 use_sqlite_database: bool = False,
+                 **kwargs):
+        self.jobs_config = jobs_config
+        self.continuous_action_mode = continuous_action_mode
+        if worker_selection != "random":
+            raise ValueError(
+                f"unrecognised worker_selection {worker_selection!r}")
+        if op_allocation not in ("sequential", "random"):
+            raise ValueError(f"unrecognised op_allocation {op_allocation!r}")
+        self.op_allocation = op_allocation
+        self.max_cluster_simulation_run_time = max_cluster_simulation_run_time
+        self.job_queue_capacity = job_queue_capacity
+
+        self.cluster = ClusterEnvironment(
+            topology_config=topology_config,
+            node_config=node_config,
+            path_to_save=path_to_save if save_cluster_data else None,
+            save_freq=save_freq,
+            use_sqlite_database=use_sqlite_database)
+
+        if continuous_action_mode:
+            # fraction of cluster workers to use
+            self.action_space = Box(0.0, 1.0, (1,), dtype=np.float32)
+            self.action_space.n = self.cluster.topology.num_workers
+        else:
+            self.action_space = Discrete(self.cluster.topology.num_workers)
+
+        if observation_function != "default":
+            raise ValueError(
+                f"unrecognised observation_function {observation_function!r}")
+        self.observation_function = JobPlacingAllNodesObservation(
+            pad_obs_kwargs=pad_obs_kwargs)
+
+        if reward_function not in REWARD_FUNCTIONS:
+            raise ValueError(
+                f"unrecognised reward_function {reward_function!r}; "
+                f"available: {sorted(REWARD_FUNCTIONS)}")
+        self.reward_function = REWARD_FUNCTIONS[reward_function](
+            **(reward_function_kwargs or {}))
+
+        if job_scheduler == "srpt_job_scheduler":
+            from ddls_tpu.agents.managers import SRPTJobScheduler
+
+            self.job_scheduler = SRPTJobScheduler()
+        elif job_scheduler == "fifo_job_scheduler":
+            from ddls_tpu.agents.managers import FIFOJobScheduler
+
+            self.job_scheduler = FIFOJobScheduler()
+        else:
+            raise ValueError(f"unrecognised job_scheduler {job_scheduler!r}")
+
+        # accepted for config parity; the reference's default info function
+        # is also a no-op (job_placing_all_nodes_environment.py:117-121)
+        self.information_function = information_function
+
+    # ------------------------------------------------------------- protocol
+    def reset(self, seed: Optional[int] = None):
+        self.cluster.reset(self.jobs_config,
+                           max_simulation_run_time=(
+                               self.max_cluster_simulation_run_time),
+                           job_queue_capacity=self.job_queue_capacity,
+                           seed=seed)
+        self.observation_function.reset(self)
+        self.observation_space = self.observation_function.observation_space
+        self.reward_function.reset(self.cluster)
+        self.obs = self.observation_function.extract(self, done=False)
+        return self.obs
+
+    def _job_to_place(self):
+        jobs = list(self.cluster.job_queue.jobs.values())
+        return jobs[0] if jobs else None
+
+    def _action_mask(self, job) -> np.ndarray:
+        """Action a (-> a+1 workers) is valid if the a+1 highest-free-memory
+        workers can hold the whole job (reference: _get_action_mask,
+        job_placing_all_nodes_environment.py:260-281)."""
+        n = self.action_space.n
+        mask = np.zeros(n, np.int32)
+        if job is None:
+            return mask
+        free = sorted((w.memory_free
+                       for w in self.cluster.topology.workers.values()),
+                      reverse=True)
+        total = job.immutable["job_total_op_memory_cost"]
+        cum = np.cumsum(free)
+        mask[:] = cum >= total
+        return mask
+
+    def _num_workers_from_action(self, action) -> int:
+        if self.continuous_action_mode:
+            frac = float(action)
+            if not 0.0 <= frac <= 1.0:
+                raise ValueError(
+                    f"continuous action must be in [0, 1], got {action}")
+            return round(frac * self.cluster.topology.num_workers)
+        return int(action) + 1
+
+    def _placement_fits(self, job, op_to_worker: Dict[str, str]) -> bool:
+        need: Dict[str, float] = {}
+        for op_id, worker_id in op_to_worker.items():
+            need[worker_id] = (need.get(worker_id, 0.0)
+                               + job.graph.memory_cost(op_id))
+        return all(self.cluster.topology.workers[w].memory_free >= mem
+                   for w, mem in need.items())
+
+    def _op_to_worker(self, job, workers) -> Dict[str, str]:
+        if self.op_allocation == "sequential":
+            cycle = itertools.cycle(workers)
+            return {op: next(cycle) for op in job.graph.op_ids}
+        return {op: str(np.random.choice(workers))
+                for op in job.graph.op_ids}
+
+    def step(self, action):
+        num_workers = self._num_workers_from_action(action)
+        control_plane = {"job_placement": {}, "job_schedule": {}}
+        job = self._job_to_place()
+        if num_workers > 0 and job is not None:
+            workers = list(np.random.choice(
+                list(self.cluster.topology.workers), size=num_workers,
+                replace=False))
+            op_to_worker = self._op_to_worker(job, workers)
+            if self._placement_fits(job, op_to_worker):
+                placement = {job.job_id: op_to_worker}
+                control_plane["job_placement"] = placement
+                control_plane["job_schedule"] = (
+                    self.job_scheduler.get_schedule(
+                        new_placements=placement, cluster=self.cluster))
+            # else: randomly drawn workers lack memory; job stays queued
+            # (the agent acts on it again next step)
+
+        self.cluster.step(control_plane)
+        reward = self.reward_function.extract(self.cluster,
+                                              done=self.cluster.is_done())
+
+        # auto-step until there is a job to act on (reference :226-232),
+        # accumulating each auto-step's reward so completions that land
+        # between agent decisions are not silently dropped from the signal
+        while len(self.cluster.job_queue) == 0 and not self.cluster.is_done():
+            self.cluster.step({"job_placement": {}, "job_schedule": {}})
+            reward += self.reward_function.extract(
+                self.cluster, done=self.cluster.is_done())
+
+        done = self.cluster.is_done()
+        if not done:
+            self.obs = self.observation_function.extract(self, done=done)
+        return self.obs, reward, done, {}

@@ -54,11 +54,15 @@ class BaseTopology:
                 ch = Channel(src, dst, n, channel_bandwidth=bandwidth)
                 self.channel_id_to_channel[ch.channel_id] = ch
 
-    def populate_workers(self, node_config: dict) -> None:
+    def populate_workers(self, node_config: dict,
+                         one_worker_per_server: bool = True) -> None:
         """Instantiate one-or-more workers per server from a node_config of
         the reference's shape (env_dev.yaml node_config block). The RAMP
         placer assumes exactly 1 worker per server
-        (reference: ramp_cluster_environment.py:180-181)."""
+        (reference: ramp_cluster_environment.py:180-181), which is enforced
+        by default; the legacy Torus cluster passes
+        ``one_worker_per_server=False`` (reference run_sim.py mounts 4
+        workers per node)."""
         server_iter = iter(self.server_ids)
         for node_type, cfg in node_config.items():
             for _ in range(cfg["num_nodes"]):
@@ -70,7 +74,7 @@ class BaseTopology:
                         f"has servers ({self.num_servers})")
                 self.server_to_workers[server_id] = []
                 for worker_cfg in cfg["workers_config"]:
-                    if worker_cfg["num_workers"] != 1:
+                    if one_worker_per_server and worker_cfg["num_workers"] != 1:
                         raise ValueError(
                             "RAMP supports exactly 1 worker per server "
                             "(reference: ramp_cluster_environment.py:181)")
@@ -80,11 +84,14 @@ class BaseTopology:
                                else get_class_from_path(spec))
                     else:
                         cls = spec
-                    worker = cls(processor_id=f"node_{server_id}_worker_0")
-                    self.workers[worker.processor_id] = worker
-                    self.worker_to_server[worker.processor_id] = server_id
-                    self.server_to_workers[server_id].append(worker.processor_id)
-                    self.worker_types.add(worker.device_type)
+                    for k in range(worker_cfg["num_workers"]):
+                        worker = cls(
+                            processor_id=f"node_{server_id}_worker_{k}")
+                        self.workers[worker.processor_id] = worker
+                        self.worker_to_server[worker.processor_id] = server_id
+                        self.server_to_workers[server_id].append(
+                            worker.processor_id)
+                        self.worker_types.add(worker.device_type)
         remaining = sum(1 for _ in server_iter)
         if remaining:
             raise ValueError(

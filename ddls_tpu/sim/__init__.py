@@ -1,12 +1,13 @@
 from ddls_tpu.sim.comm_model import one_to_one_time, ramp_all_reduce_time
 from ddls_tpu.sim.cluster import RampClusterEnvironment
+from ddls_tpu.sim.legacy_cluster import ClusterEnvironment
 from ddls_tpu.sim.actions import (Action, DepPlacement, DepSchedule,
                                   OpPartition, OpPlacement, OpSchedule)
 from ddls_tpu.sim.partition import partition_graph, partitioned_op_id
 
 __all__ = [
     "one_to_one_time", "ramp_all_reduce_time",
-    "RampClusterEnvironment",
+    "RampClusterEnvironment", "ClusterEnvironment",
     "Action", "OpPartition", "OpPlacement", "OpSchedule",
     "DepPlacement", "DepSchedule",
     "partition_graph", "partitioned_op_id",

@@ -812,10 +812,16 @@ class RampClusterEnvironment:
     def save(self) -> None:
         if self._save_thread is not None:
             self._save_thread.join()
-        self._save_thread = threading.Thread(
-            target=self._save_logs,
-            args=({"steps_log": self.steps_log,
-                   "episode_stats": self.episode_stats},))
+        # snapshot on the main thread: the background writer must not
+        # iterate dicts/lists the next step keeps mutating
+        snapshot = {
+            "steps_log": {k: (list(v) if isinstance(v, list) else v)
+                          for k, v in self.steps_log.items()},
+            "episode_stats": {k: (list(v) if isinstance(v, list) else v)
+                              for k, v in self.episode_stats.items()},
+        }
+        self._save_thread = threading.Thread(target=self._save_logs,
+                                             args=(snapshot,))
         self._save_thread.start()
 
     # static metric catalogues (reference: :1181-1280), used by loaders/loggers

@@ -1,0 +1,344 @@
+"""The legacy dynamic-tick cluster simulator (Torus path).
+
+Counterpart of the reference's older ``ClusterEnvironment``
+(ddls/environments/cluster/cluster_environment.py:28): unlike the RAMP
+simulator's one-shot lookahead (possible only because RAMP's rules forbid
+contention), this engine ticks *live* jobs that share the cluster -- each
+tick every worker runs its highest-priority ready mounted op, the clock
+advances by the shortest remaining run time (capped at the next arrival /
+simulation end), and completed ops satisfy their child dependencies at zero
+cost (the reference's documented simplification, "assume no network
+communication overhead", cluster_environment.py:286). Jobs execute
+``num_training_steps`` training steps to completion, workers may hold many
+jobs at once (no RAMP exclusivity), and servers hold many workers
+(reference run_sim.py: 16 nodes x 4 A100s).
+
+Actions are the legacy dict shape (cluster_environment.py:246):
+
+    {"job_placement": {job_id: {op_id: worker_id}},
+     "job_schedule":  {worker_id: {job_id: {op_id: priority}}}}
+
+built by the manager-style agents in :mod:`ddls_tpu.agents.managers`.
+"""
+from __future__ import annotations
+
+import gzip
+import pathlib
+import pickle
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Set
+
+import numpy as np
+
+from ddls_tpu.demands.job import ExecState, Job
+from ddls_tpu.demands.job_queue import JobQueue
+from ddls_tpu.demands.jobs_generator import JobsGenerator
+from ddls_tpu.hardware.topologies import build_topology
+from ddls_tpu.utils import (SqliteDict, Stopwatch, seed_everything,
+                            unique_experiment_dir)
+
+
+class ClusterEnvironment:
+    def __init__(self,
+                 topology_config: dict,
+                 node_config: dict,
+                 name: str = "cluster",
+                 path_to_save: Optional[str] = None,
+                 save_freq: int = 1,
+                 use_sqlite_database: bool = False):
+        self.name = name
+        self.topology_config = topology_config
+        self.node_config = node_config
+        self.save_freq = save_freq
+        self.use_sqlite_database = use_sqlite_database
+        self.path_to_save = (unique_experiment_dir(path_to_save, name)
+                             if path_to_save is not None else None)
+
+        self.topology = build_topology(topology_config)
+        self.topology.populate_workers(node_config,
+                                       one_worker_per_server=False)
+        self.stopwatch = Stopwatch()
+        self.reset_counter = 0
+        self._save_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ reset
+    def reset(self,
+              jobs_config,
+              max_simulation_run_time: float = float("inf"),
+              job_queue_capacity: int = 10,
+              seed: Optional[int] = None,
+              verbose: bool = False):
+        self.reset_counter += 1
+        if seed is not None:
+            seed_everything(seed)
+        self.stopwatch.reset()
+        self.topology.reset_devices()
+
+        if isinstance(jobs_config, JobsGenerator):
+            self.jobs_generator = jobs_config
+        else:
+            self.jobs_generator = JobsGenerator(**jobs_config)
+        self.max_simulation_run_time = (
+            float("inf") if max_simulation_run_time is None
+            else max_simulation_run_time)
+        self.job_queue = JobQueue(queue_capacity=job_queue_capacity)
+
+        self.num_jobs_arrived = 0
+        self.jobs_running: Dict[int, Job] = {}
+        self.jobs_completed: Dict[int, Job] = {}
+        self.jobs_blocked: Dict[int, Job] = {}
+        self.exec_states: Dict[int, ExecState] = {}
+        self.job_op_to_worker: Dict[tuple, str] = {}
+        self.job_op_placement: Dict[int, Dict[str, str]] = {}
+        self.job_id_to_job_idx: Dict[int, int] = {}
+        self.step_counter = 0
+
+        self.steps_log = defaultdict(list)
+        self.sim_log = defaultdict(list)
+        self.step_stats = self._init_step_stats()
+
+        self.time_next_job_to_arrive = 0.0
+        self.job_queue.add(self._get_next_job())
+        return None
+
+    def _init_step_stats(self) -> dict:
+        s = defaultdict(float)
+        s["step_counter"] = self.step_counter
+        s["step_start_time"] = self.stopwatch.time()
+        s["mean_num_active_workers"] = []
+        for key in ("num_jobs_completed", "num_jobs_running",
+                    "num_jobs_arrived", "num_jobs_blocked"):
+            s[key] = 0
+        return s
+
+    # --------------------------------------------------------------- arrivals
+    def _get_next_job(self) -> Job:
+        job = self.jobs_generator.sample_job()
+        job_idx = self.num_jobs_arrived
+        job.register_arrived(time_arrived=self.stopwatch.time(),
+                             job_idx=job_idx)
+        self.job_id_to_job_idx[job.job_id] = job_idx
+        self.time_next_job_to_arrive += (
+            self.jobs_generator.sample_interarrival_time())
+        self.num_jobs_arrived += 1
+        return job
+
+    # ------------------------------------------------------------------- step
+    def step(self, actions: dict, verbose: bool = False):
+        self.step_stats = self._init_step_stats()
+
+        self._place_jobs(actions.get("job_placement") or {})
+        self._schedule_jobs(actions.get("job_schedule") or {})
+        self.step_stats["num_jobs_running"] = len(self.jobs_running)
+
+        step_done = False
+        while not step_done:
+            time_before = self.stopwatch.time()
+            max_tick = min(
+                self.time_next_job_to_arrive - self.stopwatch.time(),
+                self.max_simulation_run_time - self.stopwatch.time())
+            completed_ops = self._tick_workers(max_tick=max(max_tick, 0.0))
+
+            # zero-cost dependency satisfaction (reference hack :286): a
+            # completed op's out-deps finish instantly, readying children
+            for job_idx, op_is in completed_ops.items():
+                state = self.exec_states[job_idx]
+                for ei in sorted(state.deps_ready):
+                    state.tick_dep(ei, state.remaining_dep[ei])
+
+            # training-step / job completion
+            for job_idx in list(completed_ops):
+                job = self.jobs_running[job_idx]
+                state = self.exec_states[job_idx]
+                if state.is_training_step_complete():
+                    job.training_step_counter += 1
+                    if job.training_step_counter >= job.num_training_steps:
+                        self._register_completed_job(job)
+                        step_done = True
+                    else:
+                        self.exec_states[job_idx] = job.reset_training_step()
+
+            # arrivals
+            if len(self.jobs_generator) > 0:
+                if (self.stopwatch.time() >= self.time_next_job_to_arrive):
+                    nxt = self._get_next_job()
+                    self.step_stats["num_jobs_arrived"] += 1
+                    if self.job_queue.can_fit(nxt):
+                        self.job_queue.add(nxt)
+                    else:
+                        self._register_blocked_job(nxt)
+                    step_done = True
+            else:
+                self.time_next_job_to_arrive = float("inf")
+
+            if self.is_done():
+                step_done = True
+
+            if (not step_done and not completed_ops
+                    and self.stopwatch.time() == time_before):
+                # no clock progress, no completions, no event: nothing can
+                # change without a new action (e.g. a queued job the caller
+                # left unplaced after the generator drained) — hand control
+                # back instead of spinning forever
+                step_done = True
+
+        # step epilogue
+        s = self.step_stats
+        s["step_end_time"] = self.stopwatch.time()
+        s["mean_num_active_workers"] = (
+            float(np.mean(s["mean_num_active_workers"]))
+            if len(s["mean_num_active_workers"]) else 0.0)
+        s["mean_worker_compute_utilisation"] = (
+            s["mean_num_active_workers"] / self.topology.num_workers)
+        s["job_queue_length"] = len(self.job_queue)
+        for key, val in s.items():
+            self.steps_log[key].append(val)
+        self.step_counter += 1
+
+        if self.path_to_save is not None and (
+                self.step_counter % self.save_freq == 0 or self.is_done()):
+            self.save()
+            if self.is_done() and self._save_thread is not None:
+                self._save_thread.join()
+        return None, None, None, self.is_done(), None
+
+    # ------------------------------------------------------------ sub-steps
+    def _place_jobs(self, job_placement: dict) -> None:
+        for job_id, op_to_worker in job_placement.items():
+            if job_id not in self.job_queue.jobs:
+                continue
+            job = self.job_queue.jobs[job_id]
+            job_idx = job.details["job_idx"]
+            for op_id, worker_id in op_to_worker.items():
+                worker = self.topology.workers[worker_id]
+                worker.mount(job, op_id)
+                job.details["mounted_workers"].add(worker_id)
+                self.job_op_to_worker[(job_idx, op_id)] = worker_id
+            self.job_op_placement[job_id] = dict(op_to_worker)
+            job.register_running(time_started=self.stopwatch.time())
+            self.jobs_running[job_idx] = job
+            self.job_queue.remove(job)
+            # legacy engine: every dep is free (no comm model)
+            self.exec_states[job_idx] = job.reset_training_step()
+
+    def _schedule_jobs(self, job_schedule: dict) -> None:
+        for worker_id, job_to_ops in job_schedule.items():
+            worker = self.topology.workers[worker_id]
+            for job_id, op_to_pri in job_to_ops.items():
+                job_idx = self.job_id_to_job_idx[job_id]
+                for op_id, pri in op_to_pri.items():
+                    worker.op_priority[(job_idx, op_id)] = pri
+
+    def _tick_workers(self, max_tick: float) -> Dict[int, List[int]]:
+        """One cluster tick: each worker's highest-priority ready op runs
+        for min(shortest remaining run time, max_tick)
+        (reference: _tick_workers, cluster_environment.py:377)."""
+        worker_to_choice: Dict[str, tuple] = {}
+        shortest = float("inf")
+        for worker_id, worker in self.topology.workers.items():
+            best = None
+            for job_idx in worker.mounted_job_idx_to_ops:
+                if job_idx not in self.exec_states:
+                    continue  # job still queued (mounted mid-step)
+                state = self.exec_states[job_idx]
+                for op_id in sorted(worker.mounted_job_idx_to_ops[job_idx]):
+                    oi = state.op_index[op_id]
+                    if oi not in state.ops_ready:
+                        continue
+                    pri = worker.op_priority.get((job_idx, op_id), 0)
+                    if best is None or pri > best[0]:
+                        best = (pri, job_idx, oi)
+            if best is not None:
+                worker_to_choice[worker_id] = best
+                shortest = min(
+                    shortest,
+                    self.exec_states[best[1]].remaining_op[best[2]])
+
+        tick = min(shortest, max_tick)
+        if not np.isfinite(tick):
+            # nothing runnable: jump straight to the next event
+            tick = max_tick if np.isfinite(max_tick) else 0.0
+
+        completed: Dict[int, List[int]] = defaultdict(list)
+        self.step_stats["mean_num_active_workers"].append(
+            len(worker_to_choice))
+        for worker_id, (pri, job_idx, oi) in worker_to_choice.items():
+            state = self.exec_states[job_idx]
+            if state.tick_op(oi, tick):
+                completed[job_idx].append(oi)
+        self.stopwatch.tick(tick)
+        return completed
+
+    # -------------------------------------------------------------- lifecycle
+    def _register_completed_job(self, job: Job) -> None:
+        job.register_completed(time_completed=self.stopwatch.time())
+        job_idx = job.details["job_idx"]
+        self.jobs_completed[job_idx] = job
+        self.step_stats["num_jobs_completed"] += 1
+        self.sim_log["job_completion_time"].append(
+            job.details["time_completed"] - job.details["time_arrived"])
+        self.sim_log["jobs_completed_num_nodes"].append(job.graph.n_ops)
+        self.sim_log["jobs_completed_num_edges"].append(job.graph.n_deps)
+        self.sim_log["jobs_completed_total_operation_memory_cost"].append(
+            job.immutable["job_total_op_memory_cost"])
+        self.sim_log["jobs_completed_total_dependency_size"].append(
+            job.immutable["job_total_dep_size"])
+        self._remove_job(job)
+
+    def _register_blocked_job(self, job: Job) -> None:
+        self.jobs_blocked[job.details["job_idx"]] = job
+        self.step_stats["num_jobs_blocked"] += 1
+        self.sim_log["jobs_blocked_num_nodes"].append(job.graph.n_ops)
+        self.sim_log["jobs_blocked_num_edges"].append(job.graph.n_deps)
+        self.sim_log["jobs_blocked_total_operation_memory_cost"].append(
+            job.immutable["job_total_op_memory_cost"])
+        self.sim_log["jobs_blocked_total_dependency_size"].append(
+            job.immutable["job_total_dep_size"])
+
+    def _remove_job(self, job: Job) -> None:
+        job_idx = job.details["job_idx"]
+        del self.jobs_running[job_idx]
+        self.exec_states.pop(job_idx, None)
+        for op_id in job.graph.op_ids:
+            worker_id = self.job_op_to_worker.pop((job_idx, op_id), None)
+            if worker_id is not None:
+                self.topology.workers[worker_id].unmount(job, op_id)
+        self.job_op_placement.pop(job.job_id, None)
+
+    def is_done(self, verbose: bool = False) -> bool:
+        if (self.max_simulation_run_time is not None
+                and self.stopwatch.time() >= self.max_simulation_run_time):
+            return True
+        return (len(self.jobs_generator) == 0 and not self.jobs_running
+                and len(self.job_queue) == 0)
+
+    # ------------------------------------------------------------------- save
+    def _save_logs(self, logs: dict) -> None:
+        out_dir = pathlib.Path(self.path_to_save) / f"reset_{self.reset_counter}"
+        out_dir.mkdir(parents=True, exist_ok=True)
+        for log_name, log in logs.items():
+            if self.use_sqlite_database:
+                db = SqliteDict(str(out_dir / f"{log_name}.sqlite"))
+                try:
+                    for key, val in dict(log).items():
+                        db[key] = val
+                    db.commit()
+                finally:
+                    db.close()
+            else:
+                with gzip.open(out_dir / f"{log_name}.pkl", "wb") as f:
+                    pickle.dump(dict(log), f)
+
+    def save(self) -> None:
+        if self._save_thread is not None:
+            self._save_thread.join()
+        # snapshot on the main thread: the background writer must not
+        # iterate dicts/lists the next step keeps mutating
+        snapshot = {
+            "steps_log": {k: list(v) for k, v in self.steps_log.items()},
+            "sim_log": {k: list(v) for k, v in self.sim_log.items()},
+        }
+        self._save_thread = threading.Thread(target=self._save_logs,
+                                             args=(snapshot,))
+        self._save_thread.start()

@@ -168,6 +168,19 @@ _REFERENCE_CLASS_ALIASES = {
         "ddls_tpu.envs.baselines.LastFitShaper",
     "ddls.environments.ramp_job_placement_shaping.agents.random.Random":
         "ddls_tpu.envs.baselines.RandomShaper",
+    # legacy simulator path
+    "ddls.environments.cluster.cluster_environment.ClusterEnvironment":
+        "ddls_tpu.sim.legacy_cluster.ClusterEnvironment",
+    "ddls.environments.job_placing.job_placing_all_nodes_environment.JobPlacingAllNodesEnvironment":
+        "ddls_tpu.envs.job_placing_env.JobPlacingAllNodesEnvironment",
+    "ddls.managers.placers.random_job_placer.RandomJobPlacer":
+        "ddls_tpu.agents.managers.RandomJobPlacer",
+    "ddls.managers.schedulers.fifo_job_scheduler.FIFOJobScheduler":
+        "ddls_tpu.agents.managers.FIFOJobScheduler",
+    "ddls.managers.schedulers.srpt_job_scheduler.SRPTJobScheduler":
+        "ddls_tpu.agents.managers.SRPTJobScheduler",
+    "ddls.managers.schedulers.random_job_scheduler.RandomJobScheduler":
+        "ddls_tpu.agents.managers.RandomJobScheduler",
 }
 
 

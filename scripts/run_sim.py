@@ -1,0 +1,91 @@
+"""Legacy dynamic-cluster demo: RandomJobPlacer + a job scheduler on a
+Torus cluster (counterpart of the reference's scripts/run_sim.py:1-97,
+which drives the legacy ClusterEnvironment with pbtxt graphs; here the
+synthetic PipeDream-format workloads are used since the reference's
+dataset is not shipped).
+
+    python scripts/run_sim.py [--scheduler fifo|srpt|random] \
+        [--num-jobs 20] [--steps 2] [--seed 0]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ddls_tpu.agents.managers import (FIFOJobScheduler, RandomJobPlacer,
+                                      RandomJobScheduler, SRPTJobScheduler)
+from ddls_tpu.sim.legacy_cluster import ClusterEnvironment
+
+SCHEDULERS = {"fifo": FIFOJobScheduler, "srpt": SRPTJobScheduler,
+              "random": RandomJobScheduler}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--scheduler", default="fifo",
+                        choices=sorted(SCHEDULERS))
+    parser.add_argument("--num-jobs", type=int, default=20)
+    parser.add_argument("--steps", type=int, default=2,
+                        help="training steps per job")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--dataset-dir", default="/tmp/ddls_tpu/run_sim_jobs")
+    parser.add_argument("--path-to-save", default=None)
+    args = parser.parse_args(argv)
+
+    from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+
+    generate_pipedream_txt_files(args.dataset_dir, n_cnn=3, n_translation=2,
+                                 seed=args.seed)
+
+    # 16-node 4x4 torus with 4 A100 workers per node (reference
+    # run_sim.py:21-39)
+    cluster = ClusterEnvironment(
+        topology_config={"type": "torus",
+                         "kwargs": {"x_dims": 4, "y_dims": 4}},
+        node_config={"type_1": {"num_nodes": 16, "workers_config": [
+            {"num_workers": 4, "worker": "A100"}]}},
+        path_to_save=args.path_to_save)
+
+    cluster.reset(
+        jobs_config={
+            "path_to_files": args.dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 1.0, "max_val": 100.0},
+            "replication_factor": max(args.num_jobs // 5, 1),
+            "job_sampling_mode": "remove",
+            "num_training_steps": args.steps,
+        },
+        max_simulation_run_time=None,
+        seed=args.seed)
+
+    placer = RandomJobPlacer()
+    scheduler = SCHEDULERS[args.scheduler]()
+
+    start = time.time()
+    steps = 0
+    while not cluster.is_done():
+        placement = placer.get_placement(cluster)
+        schedule = scheduler.get_schedule(new_placements=placement,
+                                          cluster=cluster)
+        cluster.step({"job_placement": placement,
+                      "job_schedule": schedule})
+        steps += 1
+
+    jcts = cluster.sim_log["job_completion_time"]
+    mean_jct = sum(jcts) / len(jcts) if jcts else float("nan")
+    print(f"simulation done in {steps} steps "
+          f"({time.time() - start:.2f}s wall): "
+          f"{len(cluster.jobs_completed)} completed, "
+          f"{len(cluster.jobs_blocked)} blocked, "
+          f"mean JCT {mean_jct:.1f}, "
+          f"sim time {cluster.stopwatch.time():.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

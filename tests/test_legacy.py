@@ -1,0 +1,233 @@
+"""Legacy simulator path: dynamic-tick ClusterEnvironment, manager-style
+agents, the job-placing env, and the run_sim demo (reference counterparts:
+ddls/environments/cluster/cluster_environment.py:28, ddls/managers/,
+ddls/environments/job_placing/, scripts/run_sim.py)."""
+import importlib
+
+import numpy as np
+import pytest
+
+from ddls_tpu.agents.managers import (AllReduceJobCommunicator,
+                                      FIFOJobScheduler, RandomJobPlacer,
+                                      SRPTJobPrioritiser, SRPTJobScheduler)
+from ddls_tpu.envs.job_placing_env import JobPlacingAllNodesEnvironment
+from ddls_tpu.sim.legacy_cluster import ClusterEnvironment
+
+
+def _profile(tmp_path, name, fwd, bwd):
+    path = tmp_path / f"{name}.txt"
+    path.write_text(
+        f"node1 -- Linear(id=1) -- forward_compute_time={fwd:.1f}, "
+        f"backward_compute_time={bwd:.1f}, activation_size=100.0, "
+        f"parameter_size=10.0\n")
+    return str(path)
+
+
+def _make_cluster(workers_per_node=1, dims=(2, 2), **kwargs):
+    return ClusterEnvironment(
+        topology_config={"type": "torus", "kwargs": {
+            "x_dims": dims[0], "y_dims": dims[1]}},
+        node_config={"type_1": {"num_nodes": dims[0] * dims[1],
+                                "workers_config": [
+            {"num_workers": workers_per_node, "worker": "A100"}]}},
+        **kwargs)
+
+
+def _jobs_config(path, steps=1, interarrival=1e6, replication=1):
+    return {
+        "path_to_files": path,
+        "job_interarrival_time_dist": {
+            "_target_": "ddls_tpu.demands.distributions.Fixed",
+            "val": interarrival},
+        "replication_factor": replication,
+        "job_sampling_mode": "remove",
+        "shuffle_files": False,
+        "num_training_steps": steps,
+    }
+
+
+def _place_first_job(cluster, worker_id, scheduler):
+    job = list(cluster.job_queue.jobs.values())[0]
+    placement = {job.job_id: {op: worker_id for op in job.graph.op_ids}}
+    schedule = scheduler.get_schedule(new_placements=placement,
+                                     cluster=cluster)
+    cluster.step({"job_placement": placement, "job_schedule": schedule})
+    return job
+
+
+def _drain(cluster, max_steps=50):
+    steps = 0
+    while not cluster.is_done() and steps < max_steps:
+        cluster.step({"job_placement": {}, "job_schedule": {}})
+        steps += 1
+    assert cluster.is_done()
+
+
+def test_single_job_completes_in_sequential_time(tmp_path):
+    """Deps are free in the legacy engine, so one job on one worker takes
+    exactly its sequential compute time per training step."""
+    _profile(tmp_path, "a", fwd=2.0, bwd=4.0)
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(str(tmp_path), steps=3), seed=0)
+    worker_id = next(iter(cluster.topology.workers))
+    _place_first_job(cluster, worker_id, FIFOJobScheduler())
+    assert cluster.is_done()
+    assert len(cluster.jobs_completed) == 1
+    assert cluster.sim_log["job_completion_time"] == [pytest.approx(18.0)]
+    # worker freed
+    assert not cluster.topology.workers[worker_id].mounted_job_idx_to_ops
+
+
+def test_workers_hold_multiple_jobs_and_srpt_orders_them(tmp_path):
+    """Two jobs share one worker (no RAMP exclusivity); SRPT runs the
+    shorter job to completion first."""
+    _profile(tmp_path, "a_short", fwd=1.0, bwd=1.0)   # seq 2
+    _profile(tmp_path, "b_long", fwd=3.0, bwd=3.0)    # seq 6
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(str(tmp_path), steps=1, interarrival=0.0),
+                  seed=0)
+    worker_id = next(iter(cluster.topology.workers))
+    scheduler = SRPTJobScheduler()
+    job1 = _place_first_job(cluster, worker_id, scheduler)  # admits job 2
+    assert len(cluster.job_queue) == 1
+    job2 = _place_first_job(cluster, worker_id, scheduler)
+    _drain(cluster)
+    jcts = {job.details["model"]:
+            job.details["time_completed"] - job.details["time_arrived"]
+            for job in cluster.jobs_completed.values()}
+    # shorter job runs first: 2; longer finishes at 8
+    assert jcts["a_short"] == pytest.approx(2.0)
+    assert jcts["b_long"] == pytest.approx(8.0)
+
+
+def test_fifo_orders_by_arrival(tmp_path):
+    _profile(tmp_path, "a_first", fwd=3.0, bwd=3.0)   # arrives first, seq 6
+    _profile(tmp_path, "b_second", fwd=1.0, bwd=1.0)  # seq 2
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(str(tmp_path), steps=1, interarrival=0.0),
+                  seed=0)
+    worker_id = next(iter(cluster.topology.workers))
+    scheduler = FIFOJobScheduler()
+    _place_first_job(cluster, worker_id, scheduler)
+    _place_first_job(cluster, worker_id, scheduler)
+    _drain(cluster)
+    jcts = {job.details["model"]:
+            job.details["time_completed"] - job.details["time_arrived"]
+            for job in cluster.jobs_completed.values()}
+    # first-arrived (long) job runs first despite being longer
+    assert jcts["a_first"] == pytest.approx(6.0)
+    assert jcts["b_second"] == pytest.approx(8.0)
+
+
+def test_random_job_placer_respects_memory(tmp_path):
+    _profile(tmp_path, "a", fwd=1.0, bwd=1.0)
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(str(tmp_path)), seed=0)
+    placement = RandomJobPlacer().get_placement(cluster)
+    assert len(placement) == 1
+    job = list(cluster.job_queue.jobs.values())[0]
+    ops = placement[job.job_id]
+    assert set(ops) == set(job.graph.op_ids)
+    assert all(w in cluster.topology.workers for w in ops.values())
+
+
+def test_step_returns_when_nothing_can_progress(tmp_path):
+    """A queued job left unplaced after the generator drains must hand
+    control back to the caller, not spin forever."""
+    _profile(tmp_path, "a", fwd=1.0, bwd=1.0)
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(str(tmp_path)), seed=0)
+    cluster.step({"job_placement": {}, "job_schedule": {}})  # must return
+    assert not cluster.is_done()
+    assert len(cluster.job_queue) == 1
+
+
+def test_random_job_partitioner(tmp_path):
+    from ddls_tpu.agents import RandomJobPartitioner
+    from ddls_tpu.graphs.readers import graph_from_pipedream_txt
+
+    g = graph_from_pipedream_txt(_profile(tmp_path, "a", fwd=4.0, bwd=4.0))
+    pg = RandomJobPartitioner(max_partitions_per_op=4).get_partitioned_graph(g)
+    assert pg.n_ops >= g.n_ops
+
+
+def test_prioritiser_and_communicator_stub(tmp_path):
+    _profile(tmp_path, "a", fwd=1.0, bwd=1.0)
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(str(tmp_path)), seed=0)
+    pris = SRPTJobPrioritiser().get_priorities(cluster)
+    assert len(pris) == 1
+    with pytest.raises(NotImplementedError):
+        AllReduceJobCommunicator().communicate(cluster)
+
+
+def test_run_sim_script():
+    mod = importlib.import_module("scripts.run_sim")
+    assert mod.main(["--scheduler", "srpt", "--num-jobs", "5",
+                     "--dataset-dir", "/tmp/ddls_tpu/test_run_sim"]) == 0
+
+
+def test_job_placing_env_episode(tmp_path):
+    """Full episode of the legacy placing MDP: valid actions place jobs on
+    a+1 random workers; every arrived job is completed or blocked."""
+    _profile(tmp_path, "a", fwd=1.0, bwd=2.0)
+    _profile(tmp_path, "b", fwd=2.0, bwd=3.0)
+    env = JobPlacingAllNodesEnvironment(
+        topology_config={"type": "torus", "kwargs": {"x_dims": 2,
+                                                     "y_dims": 2}},
+        node_config={"type_1": {"num_nodes": 4, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config=_jobs_config(str(tmp_path), steps=2, interarrival=5.0,
+                                 replication=3),
+        reward_function="worker_compute_utilisation",
+        pad_obs_kwargs={"max_nodes": 8})
+    obs = env.reset(seed=0)
+    assert obs["node_features"].shape == (8, 2)
+    assert obs["action_mask"].any()
+    assert env.action_space.n == 4
+
+    done, steps, rewards = False, 0, []
+    while not done and steps < 50:
+        valid = np.flatnonzero(obs["action_mask"])
+        action = int(valid[steps % len(valid)])
+        obs, reward, done, _ = env.step(action)
+        rewards.append(reward)
+        steps += 1
+    assert done
+    total = (len(env.cluster.jobs_completed)
+             + len(env.cluster.jobs_blocked))
+    assert total == env.cluster.num_jobs_arrived == 6
+    assert all(0.0 <= r <= 1.0 for r in rewards)
+
+
+def test_job_placing_env_jct_reward(tmp_path):
+    _profile(tmp_path, "a", fwd=1.0, bwd=2.0)
+    env = JobPlacingAllNodesEnvironment(
+        topology_config={"type": "torus", "kwargs": {"x_dims": 2,
+                                                     "y_dims": 2}},
+        node_config={"type_1": {"num_nodes": 4, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config=_jobs_config(str(tmp_path), steps=1),
+        reward_function="mean_job_completion_time",
+        pad_obs_kwargs={"max_nodes": 8})
+    obs = env.reset(seed=0)
+    obs, reward, done, _ = env.step(0)  # 1 worker
+    assert done
+    # JCT = 3 -> reward = -log10(3 + 1)
+    assert reward == pytest.approx(-np.log10(4.0))
+
+
+def test_continuous_action_mode(tmp_path):
+    _profile(tmp_path, "a", fwd=1.0, bwd=2.0)
+    env = JobPlacingAllNodesEnvironment(
+        topology_config={"type": "torus", "kwargs": {"x_dims": 2,
+                                                     "y_dims": 2}},
+        node_config={"type_1": {"num_nodes": 4, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config=_jobs_config(str(tmp_path), steps=1),
+        continuous_action_mode=True,
+        pad_obs_kwargs={"max_nodes": 8})
+    env.reset(seed=0)
+    _, _, done, _ = env.step(0.5)  # half the cluster = 2 workers
+    assert done
+    assert len(env.cluster.jobs_completed) == 1
