@@ -2,9 +2,15 @@ from ddls_tpu.envs.partitioning_env import RampJobPartitioningEnvironment
 from ddls_tpu.envs.placement_shaping_env import (
     RampJobPlacementShapingEnvironment)
 from ddls_tpu.envs.job_placing_env import JobPlacingAllNodesEnvironment
+from ddls_tpu.envs.job_scheduling_env import JobSchedulingEnvironment
+from ddls_tpu.envs.interfaces import (DDLSInformationFunction,
+                                      DDLSObservationFunction,
+                                      DDLSRewardFunction)
 from ddls_tpu.envs import baselines, rewards, spaces
 
 __all__ = ["RampJobPartitioningEnvironment",
            "RampJobPlacementShapingEnvironment",
-           "JobPlacingAllNodesEnvironment", "baselines", "rewards",
+           "JobPlacingAllNodesEnvironment", "JobSchedulingEnvironment",
+           "DDLSObservationFunction", "DDLSRewardFunction",
+           "DDLSInformationFunction", "baselines", "rewards",
            "spaces"]

@@ -225,9 +225,10 @@ class JobPlacingAllNodesEnvironment:
         else:
             raise ValueError(f"unrecognised job_scheduler {job_scheduler!r}")
 
-        # accepted for config parity; the reference's default info function
-        # is also a no-op (job_placing_all_nodes_environment.py:117-121)
-        self.information_function = information_function
+        from ddls_tpu.envs.interfaces import make_information_function
+
+        self.information_function = make_information_function(
+            information_function)
 
     # ------------------------------------------------------------- protocol
     def reset(self, seed: Optional[int] = None):
@@ -239,6 +240,7 @@ class JobPlacingAllNodesEnvironment:
         self.observation_function.reset(self)
         self.observation_space = self.observation_function.observation_space
         self.reward_function.reset(self.cluster)
+        self.information_function.reset(self)
         self.obs = self.observation_function.extract(self, done=False)
         return self.obs
 
@@ -305,18 +307,25 @@ class JobPlacingAllNodesEnvironment:
             # (the agent acts on it again next step)
 
         self.cluster.step(control_plane)
-        reward = self.reward_function.extract(self.cluster,
-                                              done=self.cluster.is_done())
+        step_rewards = [self.reward_function.extract(
+            self.cluster, done=self.cluster.is_done())]
 
         # auto-step until there is a job to act on (reference :226-232),
-        # accumulating each auto-step's reward so completions that land
+        # folding each auto-step's reward in so completions that land
         # between agent decisions are not silently dropped from the signal
         while len(self.cluster.job_queue) == 0 and not self.cluster.is_done():
             self.cluster.step({"job_placement": {}, "job_schedule": {}})
-            reward += self.reward_function.extract(
-                self.cluster, done=self.cluster.is_done())
+            step_rewards.append(self.reward_function.extract(
+                self.cluster, done=self.cluster.is_done()))
+        if isinstance(self.reward_function, WorkerComputeUtilisation):
+            # utilisation is a per-step fraction: average, keeping [0, 1]
+            reward = float(np.mean(step_rewards))
+        else:
+            # JCT rewards score disjoint sets of completions: sum
+            reward = float(np.sum(step_rewards))
 
         done = self.cluster.is_done()
         if not done:
             self.obs = self.observation_function.extract(self, done=done)
-        return self.obs, reward, done, {}
+        info = self.information_function.extract(self, done=done)
+        return self.obs, reward, done, info

@@ -1,0 +1,17 @@
+"""Job-scheduling MDP placeholder.
+
+The reference ships this as an empty 19-line stub
+(ddls/environments/job_scheduling/job_scheduling_environment.py:1) — the
+experiment was never built. Kept for component parity; scheduling decisions
+in the working paths are made by the SRPT op/dep schedulers (RAMP) and the
+manager-style job schedulers (legacy).
+"""
+from __future__ import annotations
+
+
+class JobSchedulingEnvironment:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "JobSchedulingEnvironment is unimplemented in the reference "
+            "too (a 19-line stub); use RampJobPartitioningEnvironment or "
+            "JobPlacingAllNodesEnvironment")

@@ -103,6 +103,10 @@ class RampJobPartitioningEnvironment:
         self.reward_function = make_reward_function(
             reward_function, reward_function_kwargs)
 
+        from ddls_tpu.envs.interfaces import make_information_function
+        self.information_function = make_information_function(
+            information_function)
+
         self.op_placer = OP_PLACERS[op_placer](**(op_placer_kwargs or {}))
         self.op_scheduler = OP_SCHEDULERS[op_scheduler](
             **(op_scheduler_kwargs or {}))
@@ -120,6 +124,7 @@ class RampJobPartitioningEnvironment:
         self.observation_function.reset(self)
         self.observation_space = self.observation_function.observation_space
         self.reward_function.reset(env=self)
+        self.information_function.reset(self)
         self.obs = self._get_observation()
         return self.obs
 
@@ -206,6 +211,7 @@ class RampJobPartitioningEnvironment:
         self.done = self._is_done()
         if not self.done:
             self.obs = self._get_observation()
-        self.info = {}
+        self.info = self.information_function.extract(env=self,
+                                                      done=self.done)
         self.step_counter += 1
         return self.obs, self.reward, self.done, self.info

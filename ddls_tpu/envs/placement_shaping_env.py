@@ -101,6 +101,10 @@ class RampJobPlacementShapingEnvironment:
         self.reward_function = make_reward_function(
             reward_function, reward_function_kwargs)
 
+        from ddls_tpu.envs.interfaces import make_information_function
+        self.information_function = make_information_function(
+            information_function)
+
         self.op_partitioner = OP_PARTITIONERS[op_partitioner](
             **(op_partitioner_kwargs or {}))
         self.op_placer = OP_PLACERS[op_placer](**(op_placer_kwargs or {}))
@@ -122,6 +126,7 @@ class RampJobPlacementShapingEnvironment:
         self.observation_function.reset(self)
         self.observation_space = self.observation_function.observation_space
         self.reward_function.reset(env=self)
+        self.information_function.reset(self)
         self.obs = self._get_observation()
         return self.obs
 
@@ -213,6 +218,7 @@ class RampJobPlacementShapingEnvironment:
         if not self.done:
             self._update_op_partition()
             self.obs = self._get_observation()
-        self.info = {}
+        self.info = self.information_function.extract(env=self,
+                                                      done=self.done)
         self.step_counter += 1
         return self.obs, self.reward, self.done, self.info

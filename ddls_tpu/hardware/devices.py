@@ -67,6 +67,21 @@ class Processor:
         return f"{self.device_type}({self.processor_id})"
 
 
+class GPU(Processor):
+    """Generic GPU worker with configurable memory (reference's legacy
+    ddls/devices/processors/gpus/gpu.py:6; unused by the RAMP path but kept
+    for the legacy cluster and custom node configs)."""
+
+    device_type = "GPU"
+    memory_capacity = int(32e9)
+
+    def __init__(self, processor_id: Optional[str] = None,
+                 memory_capacity: Optional[float] = None):
+        if memory_capacity is not None:
+            self.memory_capacity = int(memory_capacity)
+        super().__init__(processor_id)
+
+
 class A100(Processor):
     """80 GB HBM GPU worker (reference: ddls/devices/processors/gpus/A100.py)."""
 
@@ -88,7 +103,7 @@ class TPUv5e(Processor):
     memory_capacity = int(16e9)
 
 
-DEVICE_TYPES = {cls.device_type: cls for cls in (A100, TPUv4, TPUv5e)}
+DEVICE_TYPES = {cls.device_type: cls for cls in (GPU, A100, TPUv4, TPUv5e)}
 
 
 def channel_id(src: str, dst: str, channel_number: int) -> str:

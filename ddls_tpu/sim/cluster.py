@@ -35,10 +35,8 @@ ultimately transfer.
 """
 from __future__ import annotations
 
-import gzip
 import math
 import pathlib
-import pickle
 import threading
 from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
@@ -49,8 +47,8 @@ from ddls_tpu.demands.job import Job
 from ddls_tpu.demands.job_queue import JobQueue
 from ddls_tpu.demands.jobs_generator import JobsGenerator
 from ddls_tpu.hardware.topologies import build_topology
-from ddls_tpu.utils import (SqliteDict, Stopwatch, seed_everything,
-                            unique_experiment_dir)
+from ddls_tpu.utils import Stopwatch, seed_everything, unique_experiment_dir
+from ddls_tpu.utils.common import save_logs_to_dir, snapshot_logs
 
 EdgeId = Tuple[str, str]
 
@@ -791,35 +789,17 @@ class RampClusterEnvironment:
 
     # ------------------------------------------------------------------- save
     def _save_logs(self, logs: dict) -> None:
-        out_dir = pathlib.Path(self.path_to_save) / f"reset_{self.reset_counter}"
-        out_dir.mkdir(parents=True, exist_ok=True)
-        if self.use_sqlite_database:
-            # one kv database per log, keys overwritten with the latest
-            # accumulated state (reference: ramp_cluster_environment.py:1570)
-            for log_name, log in logs.items():
-                db = SqliteDict(str(out_dir / f"{log_name}.sqlite"))
-                try:
-                    for key, val in dict(log).items():
-                        db[key] = val
-                    db.commit()
-                finally:
-                    db.close()
-        else:
-            for log_name, log in logs.items():
-                with gzip.open(out_dir / f"{log_name}.pkl", "wb") as f:
-                    pickle.dump(dict(log), f)
+        # keys are overwritten with the latest accumulated state
+        # (reference: ramp_cluster_environment.py:1570)
+        save_logs_to_dir(
+            pathlib.Path(self.path_to_save) / f"reset_{self.reset_counter}",
+            logs, use_sqlite=self.use_sqlite_database)
 
     def save(self) -> None:
         if self._save_thread is not None:
             self._save_thread.join()
-        # snapshot on the main thread: the background writer must not
-        # iterate dicts/lists the next step keeps mutating
-        snapshot = {
-            "steps_log": {k: (list(v) if isinstance(v, list) else v)
-                          for k, v in self.steps_log.items()},
-            "episode_stats": {k: (list(v) if isinstance(v, list) else v)
-                              for k, v in self.episode_stats.items()},
-        }
+        snapshot = snapshot_logs({"steps_log": self.steps_log,
+                                  "episode_stats": self.episode_stats})
         self._save_thread = threading.Thread(target=self._save_logs,
                                              args=(snapshot,))
         self._save_thread.start()

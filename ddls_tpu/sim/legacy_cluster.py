@@ -22,9 +22,7 @@ built by the manager-style agents in :mod:`ddls_tpu.agents.managers`.
 """
 from __future__ import annotations
 
-import gzip
 import pathlib
-import pickle
 import threading
 from collections import defaultdict
 from typing import Dict, List, Optional, Set
@@ -35,8 +33,8 @@ from ddls_tpu.demands.job import ExecState, Job
 from ddls_tpu.demands.job_queue import JobQueue
 from ddls_tpu.demands.jobs_generator import JobsGenerator
 from ddls_tpu.hardware.topologies import build_topology
-from ddls_tpu.utils import (SqliteDict, Stopwatch, seed_everything,
-                            unique_experiment_dir)
+from ddls_tpu.utils import Stopwatch, seed_everything, unique_experiment_dir
+from ddls_tpu.utils.common import save_logs_to_dir, snapshot_logs
 
 
 class ClusterEnvironment:
@@ -315,30 +313,15 @@ class ClusterEnvironment:
 
     # ------------------------------------------------------------------- save
     def _save_logs(self, logs: dict) -> None:
-        out_dir = pathlib.Path(self.path_to_save) / f"reset_{self.reset_counter}"
-        out_dir.mkdir(parents=True, exist_ok=True)
-        for log_name, log in logs.items():
-            if self.use_sqlite_database:
-                db = SqliteDict(str(out_dir / f"{log_name}.sqlite"))
-                try:
-                    for key, val in dict(log).items():
-                        db[key] = val
-                    db.commit()
-                finally:
-                    db.close()
-            else:
-                with gzip.open(out_dir / f"{log_name}.pkl", "wb") as f:
-                    pickle.dump(dict(log), f)
+        save_logs_to_dir(
+            pathlib.Path(self.path_to_save) / f"reset_{self.reset_counter}",
+            logs, use_sqlite=self.use_sqlite_database)
 
     def save(self) -> None:
         if self._save_thread is not None:
             self._save_thread.join()
-        # snapshot on the main thread: the background writer must not
-        # iterate dicts/lists the next step keeps mutating
-        snapshot = {
-            "steps_log": {k: list(v) for k, v in self.steps_log.items()},
-            "sim_log": {k: list(v) for k, v in self.sim_log.items()},
-        }
+        snapshot = snapshot_logs({"steps_log": self.steps_log,
+                                  "sim_log": self.sim_log})
         self._save_thread = threading.Thread(target=self._save_logs,
                                              args=(snapshot,))
         self._save_thread.start()

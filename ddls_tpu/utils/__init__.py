@@ -1,3 +1,4 @@
+from ddls_tpu.utils.profiling import enable_xla_dump, jax_profiler_trace
 from ddls_tpu.utils.common import (
     SqliteDict,
     Stopwatch,
@@ -12,6 +13,8 @@ from ddls_tpu.utils.common import (
 
 __all__ = [
     "SqliteDict",
+    "enable_xla_dump",
+    "jax_profiler_trace",
     "Stopwatch",
     "flatten_lists",
     "get_class_from_path",

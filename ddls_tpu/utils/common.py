@@ -58,6 +58,38 @@ class SqliteDict:
         self._conn.close()
 
 
+def save_logs_to_dir(out_dir, logs: Mapping[str, Mapping[str, Any]],
+                     use_sqlite: bool) -> None:
+    """Write each named log dict into ``out_dir`` as either a gzip pickle
+    or a SqliteDict database. Callers must pass a SNAPSHOT (not live,
+    still-mutating dicts) when invoking this from a background thread."""
+    out_dir = pathlib.Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for log_name, log in logs.items():
+        if use_sqlite:
+            db = SqliteDict(str(out_dir / f"{log_name}.sqlite"))
+            try:
+                for key, val in dict(log).items():
+                    db[key] = val
+                db.commit()
+            finally:
+                db.close()
+        else:
+            import gzip
+
+            with gzip.open(out_dir / f"{log_name}.pkl", "wb") as f:
+                pickle.dump(dict(log), f)
+
+
+def snapshot_logs(logs: Mapping[str, Mapping[str, Any]]
+                  ) -> dict:
+    """Shallow-copy each log's dict and list values on the calling thread
+    so a background writer never races the simulator's mutations."""
+    return {name: {k: (list(v) if isinstance(v, list) else v)
+                   for k, v in log.items()}
+            for name, log in logs.items()}
+
+
 def merge_logs(old: Any, new: Any) -> Any:
     """Extend-by-key merge for incremental log flushes: dicts merge
     recursively, lists extend, scalars overwrite."""

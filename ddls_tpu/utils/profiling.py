@@ -1,0 +1,43 @@
+"""Profiling hooks: jax.profiler traces and XLA dumps.
+
+The reference profiles with cProfile only
+(scripts/test_heuristic_from_config.py:73-84); on TPU the equivalents are
+``jax.profiler`` traces (viewable in TensorBoard/Perfetto/xprof) and XLA
+HLO dumps (SURVEY.md §5.1). Both are wired into the CLI entry points via
+``experiment.profile_jax`` / ``experiment.xla_dump_to`` config flags.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def jax_profiler_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """Trace device/host activity for the enclosed block; no-op when
+    ``trace_dir`` is falsy. Output is a TensorBoard-compatible profile
+    under ``trace_dir``."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(str(trace_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def enable_xla_dump(dump_dir: str) -> None:
+    """Ask XLA to dump HLO (text + optimised) for every compilation.
+
+    Must run BEFORE the first jax backend initialisation — XLA_FLAGS is
+    read once at backend start, which is why the CLI entry points call
+    this before building any epoch loop or learner.
+    """
+    flag = f"--xla_dump_to={dump_dir}"
+    existing = os.environ.get("XLA_FLAGS", "")
+    if flag not in existing:
+        os.environ["XLA_FLAGS"] = f"{existing} {flag}".strip()

@@ -43,20 +43,30 @@ def main(argv=None) -> int:
     print(f"Initialised EvalLoop with actor "
           f"{type(eval_loop.actor).__name__}")
 
+    # jax.profiler trace (TPU equivalent of the cProfile hook; SURVEY §5.1)
+    from ddls_tpu.utils.profiling import jax_profiler_trace
+
+    jax_trace_dir = (os.path.join(save_dir, "jax_trace")
+                     if experiment.get("profile_jax") else None)
+
     if experiment.get("profile_time"):
         import cProfile
         import pstats
 
         profiler = cProfile.Profile()
         profiler.enable()
-        results = eval_loop.run(seed=seed)
+        with jax_profiler_trace(jax_trace_dir):
+            results = eval_loop.run(seed=seed)
         profiler.disable()
         prof_path = os.path.join(save_dir, "profile.prof")
         profiler.dump_stats(prof_path)
         pstats.Stats(profiler).sort_stats("cumulative").print_stats(20)
         print(f"Saved profile to {prof_path}")
     else:
-        results = eval_loop.run(seed=seed)
+        with jax_profiler_trace(jax_trace_dir):
+            results = eval_loop.run(seed=seed)
+    if jax_trace_dir:
+        print(f"Saved jax profiler trace under {jax_trace_dir}")
 
     stats = results["episode_stats"]
     print(f"episode return {results['episode_return']:.3f} over "

@@ -72,6 +72,13 @@ def main(argv=None) -> int:
     cfg = load_config(args.config_path, args.config_name, args.overrides)
     experiment = cfg.get("experiment", {})
 
+    # XLA dump must be requested before the first backend init (SURVEY
+    # §5.1; jax is only imported lazily below, so this is early enough)
+    if experiment.get("xla_dump_to"):
+        from ddls_tpu.utils.profiling import enable_xla_dump
+
+        enable_xla_dump(experiment["xla_dump_to"])
+
     # opt-in multi-host: join the global JAX runtime before any backend
     # init so the mesh spans every host's devices (SURVEY.md §5.8; replaces
     # the reference's Ray worker topology)
@@ -124,7 +131,14 @@ def main(argv=None) -> int:
                                  **cfg.get("checkpointer", {}))
                     if primary else None)
 
-    summary = launcher.run(logger=logger, checkpointer=checkpointer)
+    from ddls_tpu.utils.profiling import jax_profiler_trace
+
+    jax_trace_dir = (os.path.join(save_dir, "jax_trace")
+                     if (primary and experiment.get("profile_jax")) else None)
+    with jax_profiler_trace(jax_trace_dir):
+        summary = launcher.run(logger=logger, checkpointer=checkpointer)
+    if jax_trace_dir:
+        print(f"Saved jax profiler trace under {jax_trace_dir}")
     if primary:
         print(f"Best checkpoint: {summary['best_checkpoint']} "
               f"({epoch_loop.metric}={summary['best_metric_value']})")

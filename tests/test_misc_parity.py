@@ -1,0 +1,95 @@
+"""Misc component parity: information functions, profiling hooks, generic
+GPU device, and the job-scheduling stub."""
+import os
+
+import numpy as np
+import pytest
+
+from ddls_tpu.envs import (DDLSInformationFunction, JobSchedulingEnvironment,
+                           RampJobPartitioningEnvironment)
+from ddls_tpu.envs.interfaces import make_information_function
+from ddls_tpu.hardware.devices import DEVICE_TYPES, GPU
+from ddls_tpu.utils import enable_xla_dump, jax_profiler_trace
+
+
+def _env_config(dataset_dir, **over):
+    cfg = dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 100.0},
+            "replication_factor": 2,
+            "job_sampling_mode": "remove",
+            "num_training_steps": 2},
+        max_partitions_per_op=4,
+        reward_function="job_acceptance",
+        max_simulation_run_time=5e4,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+    cfg.update(over)
+    return cfg
+
+
+def test_information_function_episode_stats(dataset_dir):
+    env = RampJobPartitioningEnvironment(
+        **_env_config(dataset_dir, information_function="episode_stats"))
+    obs = env.reset(seed=0)
+    _, _, _, info = env.step(int(np.flatnonzero(obs["action_mask"])[0]))
+    assert set(info) == {"num_jobs_arrived", "num_jobs_completed",
+                         "num_jobs_blocked"}
+    assert info["num_jobs_arrived"] >= 1
+
+
+def test_information_function_default_and_unknown(dataset_dir):
+    env = RampJobPartitioningEnvironment(**_env_config(dataset_dir))
+    obs = env.reset(seed=0)
+    _, _, _, info = env.step(int(np.flatnonzero(obs["action_mask"])[0]))
+    assert info == {}
+    with pytest.raises(ValueError, match="information_function"):
+        make_information_function("nope")
+    assert isinstance(make_information_function("default"),
+                      DDLSInformationFunction)
+
+
+def test_generic_gpu_device():
+    assert "GPU" in DEVICE_TYPES
+    gpu = GPU(processor_id="g0", memory_capacity=8e9)
+    assert gpu.memory_capacity == int(8e9)
+    assert GPU(processor_id="g1").memory_capacity == int(32e9)
+
+
+def test_job_scheduling_stub():
+    with pytest.raises(NotImplementedError):
+        JobSchedulingEnvironment()
+
+
+def test_jax_profiler_trace(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    trace_dir = tmp_path / "trace"
+    with jax_profiler_trace(str(trace_dir)):
+        jax.block_until_ready(jnp.ones(8) * 2)
+    files = list(trace_dir.rglob("*"))
+    assert files, "trace produced no artifacts"
+    # disabled -> no-op
+    with jax_profiler_trace(None):
+        pass
+
+
+def test_enable_xla_dump(monkeypatch, tmp_path):
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    enable_xla_dump(str(tmp_path / "dump"))
+    flags = os.environ["XLA_FLAGS"]
+    assert "--xla_force_host_platform_device_count=8" in flags
+    assert f"--xla_dump_to={tmp_path / 'dump'}" in flags
+    enable_xla_dump(str(tmp_path / "dump"))  # idempotent
+    assert flags == os.environ["XLA_FLAGS"]
