@@ -257,9 +257,15 @@ def main(argv=None) -> int:
     parser.add_argument("--probe-timeout", type=float, default=240.0)
     args = parser.parse_args(argv)
     if args.num_envs is None:
-        # one env worker per core, 8+ to match the reference's 8 rollout
-        # workers when the host has them
-        args.num_envs = max(2, min(16, _available_cores()))
+        cores = _available_cores()
+        if cores == 1:
+            # in-process serial envs: 8 of them amortise the tunnelled-TPU
+            # sampling round-trip over a useful batch at no extra host cost
+            args.num_envs = 8
+        else:
+            # one subprocess env worker per core (reference: 8 rollout
+            # workers); more would just oversubscribe the host
+            args.num_envs = max(2, min(16, cores))
 
     if args.mode == "sim":
         # no device in the loop: never touch the (possibly hanging) TPU

@@ -272,7 +272,11 @@ class FirstFitDepPlacer:
                     ok = False
                     break
                 path, valid_channels = options
-                ch_num = random.choice(valid_channels)
+                # single-channel topologies (the canonical RAMP config) skip
+                # the uniform pick — random.choice dominates this loop at
+                # ~1.5k placed deps per env step otherwise
+                ch_num = (valid_channels[0] if len(valid_channels) == 1
+                          else random.choice(valid_channels))
                 for idx in range(len(path) - 1):
                     ch_id = make_channel_id(path[idx], path[idx + 1], ch_num)
                     dep_to_channels[dep_id].add(ch_id)

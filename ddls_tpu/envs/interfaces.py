@@ -61,13 +61,16 @@ class DefaultInformation(DDLSInformationFunction):
 class EpisodeStatsInformation(DDLSInformationFunction):
     """Surfaces headline cluster counters into ``info`` each step —
     useful for RL-framework callbacks that only see (obs, reward, done,
-    info) tuples."""
-
-    KEYS = ("num_jobs_arrived", "num_jobs_completed", "num_jobs_blocked")
+    info) tuples. Reads the live lifecycle tables both cluster simulators
+    maintain (the legacy ClusterEnvironment has no episode_stats dict)."""
 
     def extract(self, env, done: bool) -> Dict[str, Any]:
-        stats = getattr(env.cluster, "episode_stats", {})
-        return {key: stats.get(key, 0) for key in self.KEYS}
+        cluster = env.cluster
+        return {
+            "num_jobs_arrived": int(cluster.num_jobs_arrived),
+            "num_jobs_completed": len(cluster.jobs_completed),
+            "num_jobs_blocked": len(cluster.jobs_blocked),
+        }
 
 
 INFORMATION_FUNCTIONS = {

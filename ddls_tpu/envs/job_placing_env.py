@@ -31,6 +31,9 @@ from ddls_tpu.envs.rewards import _log_transform as _transform_with_log
 
 
 class WorkerComputeUtilisation:
+    # per-step fraction: averaging across auto-steps keeps it in [0, 1]
+    aggregate = "mean"
+
     def reset(self, cluster) -> None:
         pass
 
@@ -317,11 +320,12 @@ class JobPlacingAllNodesEnvironment:
             self.cluster.step({"job_placement": {}, "job_schedule": {}})
             step_rewards.append(self.reward_function.extract(
                 self.cluster, done=self.cluster.is_done()))
-        if isinstance(self.reward_function, WorkerComputeUtilisation):
-            # utilisation is a per-step fraction: average, keeping [0, 1]
+        # how step rewards combine is a property of the reward function:
+        # "mean" for per-step rates, "sum" (default) for rewards scoring
+        # disjoint sets of completions
+        if getattr(self.reward_function, "aggregate", "sum") == "mean":
             reward = float(np.mean(step_rewards))
         else:
-            # JCT rewards score disjoint sets of completions: sum
             reward = float(np.sum(step_rewards))
 
         done = self.cluster.is_done()

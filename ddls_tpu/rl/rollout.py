@@ -15,6 +15,7 @@ callbacks collect (ddls/environments/ramp_cluster/utils.py:25-73).
 from __future__ import annotations
 
 import multiprocessing as mp
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -255,11 +256,17 @@ class RolloutCollector:
         self.learner = learner
         self.rollout_length = rollout_length
         B = vec_env.num_envs
-        if pipeline is None:
+        if pipeline is None and (B < 2 or B % 2
+                                 or jax.default_backend() == "cpu"):
             # overlap only exists when sampling runs on an accelerator; on a
             # CPU backend the device IS the host, and two half-batch calls
             # just double the sampling overhead
-            pipeline = B >= 2 and B % 2 == 0 and jax.default_backend() != "cpu"
+            pipeline = False
+        # pipeline=None: decide adaptively after timing the first collect.
+        # Per step, pipelined cost ~ 2*max(sample, env/2) vs non-pipelined
+        # sample + env, so splitting wins exactly when sampling is cheaper
+        # than env stepping — under a high-latency tunnelled TPU with fast
+        # host envs, pipelining *doubles* the dominant round-trip count.
         self.pipeline = pipeline
         self._needs_reset = True
 
@@ -280,19 +287,34 @@ class RolloutCollector:
         rew_buf = np.zeros((T, B), dtype=np.float32)
         done_buf = np.zeros((T, B), dtype=bool)
 
+        measure = self.pipeline is None and B >= 2 and B % 2 == 0
+        sample_time = env_time = 0.0
         for t in range(T):
             batched = stack_obs(self.vec_env.obs)
             rng, step_rng = jax.random.split(rng)
+            # t == 0 pays jit trace+compile for sample_actions; excluding
+            # it keeps the measurement at steady-state cost
+            timing = measure and t > 0
+            t0 = time.perf_counter() if timing else 0.0
             actions, logp, values = self.learner.sample_actions(
                 params, batched, step_rng)
             actions = np.asarray(actions)
+            if timing:
+                sample_time += time.perf_counter() - t0
             obs_buf.append(batched)
             act_buf[t] = actions
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(values)
+            t0 = time.perf_counter() if timing else 0.0
             _, rewards, dones = self.vec_env.step(actions)
+            if timing:
+                env_time += time.perf_counter() - t0
             rew_buf[t] = rewards
             done_buf[t] = dones
+        if measure and T > 1:
+            # see __init__: split-batch overlap wins iff sampling (device
+            # round-trip incl. dispatch+fetch) is cheaper than env stepping
+            self.pipeline = sample_time < env_time
 
         final = stack_obs(self.vec_env.obs)
         rng, val_rng = jax.random.split(rng)

@@ -37,7 +37,9 @@ def enable_xla_dump(dump_dir: str) -> None:
     read once at backend start, which is why the CLI entry points call
     this before building any epoch loop or learner.
     """
-    flag = f"--xla_dump_to={dump_dir}"
-    existing = os.environ.get("XLA_FLAGS", "")
-    if flag not in existing:
-        os.environ["XLA_FLAGS"] = f"{existing} {flag}".strip()
+    # replace any existing --xla_dump_to flag (keyed comparison, not a raw
+    # substring check, so a stale dump dir never shadows the requested one)
+    kept = [f for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_dump_to=")]
+    kept.append(f"--xla_dump_to={dump_dir}")
+    os.environ["XLA_FLAGS"] = " ".join(kept)
