@@ -360,43 +360,94 @@ class RLEpochLoop:
         np_state = np.random.get_state()
         py_state = _random.getstate()
         try:
-            env = self.make_eval_env()
             base_seed = (seed if seed is not None
                          else (self.test_seed
                                if self.test_seed is not None
                                else self.seed + 10_000))
-            episodes = []
-            for ep in range(num_episodes):
-                record = self._run_greedy_episode(env, base_seed + ep)
-                episodes.append(record)
+            episodes = self._run_greedy_episodes_batched(num_episodes,
+                                                         base_seed)
             return _episode_summary(episodes)
         finally:
             np.random.set_state(np_state)
             _random.setstate(py_state)
 
-    def _run_greedy_episode(self, env, seed: int) -> Dict[str, Any]:
-        import jax
+    def _run_greedy_episodes_batched(self, num_episodes: int,
+                                     base_seed: int) -> List[dict]:
+        """One episode per parallel eval env, all driven by a single
+        jitted greedy call per step (the TPU-native replacement for the
+        reference's parallel eval workers, eval_default.yaml). Finished
+        envs keep contributing their last obs to the (static-shape) batch
+        but are no longer stepped.
 
-        from ddls_tpu.rl.rollout import harvest_episode_record
+        Env stochasticity is drawn lazily from the process-global
+        numpy/random state that ``env.reset(seed)`` seeds, so each env's
+        global-RNG state is swapped in around its reset and every step —
+        episode i consumes exactly the stream seeded by ``base_seed + i``,
+        bit-identical to running the episodes sequentially (and therefore
+        invariant to ``num_episodes``)."""
+        import random as _random
+
+        from ddls_tpu.rl.rollout import harvest_episode_record, stack_obs
+
+        def rng_state():
+            return (np.random.get_state(), _random.getstate())
+
+        def set_rng_state(state) -> None:
+            np.random.set_state(state[0])
+            _random.setstate(state[1])
+
+        envs = [self.make_eval_env() for _ in range(num_episodes)]
+        obs, rng_states = [], []
+        for i, env in enumerate(envs):
+            obs.append(env.reset(seed=base_seed + i))
+            rng_states.append(rng_state())
+        done = np.zeros(num_episodes, dtype=bool)
+        totals = np.zeros(num_episodes)
+        lengths = np.zeros(num_episodes, dtype=np.int64)
+        records: List[Optional[dict]] = [None] * num_episodes
+        while not done.all():
+            actions = self._greedy_actions(stack_obs(obs))
+            for i in np.flatnonzero(~done):
+                set_rng_state(rng_states[i])
+                obs[i], reward, d, _ = envs[i].step(int(actions[i]))
+                rng_states[i] = rng_state()
+                totals[i] += reward
+                lengths[i] += 1
+                if d:
+                    done[i] = True
+                    records[i] = harvest_episode_record(
+                        envs[i], i, totals[i], lengths[i])
+        return [r for r in records if r is not None]
+
+    def _run_greedy_episode(self, env, seed: int) -> Dict[str, Any]:
+        """Single-episode evaluation on a caller-provided env (RLEvalLoop
+        surface); same greedy policy as the batched path."""
+        from ddls_tpu.rl.rollout import harvest_episode_record, stack_obs
 
         obs = env.reset(seed=seed)
         done = False
         total, steps = 0.0, 0
         while not done:
-            batched = jax.tree_util.tree_map(
-                lambda x: np.asarray(x)[None], obs)
-            obs, reward, done, _ = env.step(self._greedy_action(batched))
+            action = int(self._greedy_actions(stack_obs([obs]))[0])
+            obs, reward, done, _ = env.step(action)
             total += reward
             steps += 1
         return harvest_episode_record(env, 0, total, steps)
 
-    def _greedy_action(self, batched_obs) -> int:
-        """Greedy action for a [1, ...] obs batch; PPO: argmax of the
-        (mask-adjusted) policy logits."""
+    def _greedy_actions(self, batched_obs) -> np.ndarray:
+        """Greedy actions for a [B, ...] obs batch via one jitted device
+        call; PPO-family: argmax of the (mask-adjusted) policy logits."""
         import jax
 
-        logits, _ = self.learner.apply_fn(self.state.params, batched_obs)
-        return int(np.asarray(jax.device_get(logits))[0].argmax())
+        if not hasattr(self, "_jit_greedy"):
+            self._jit_greedy = jax.jit(
+                lambda p, o: self.learner.apply_fn(p, o)[0].argmax(axis=-1))
+        return np.asarray(jax.device_get(
+            self._jit_greedy(self.state.params, batched_obs)))
+
+    def _greedy_action(self, batched_obs) -> int:
+        """Greedy action for a [1, ...] obs batch."""
+        return int(self._greedy_actions(batched_obs)[0])
 
     # ----------------------------------------------------------- checkpoints
     def save_agent_checkpoint(self, path: str) -> str:
@@ -587,13 +638,16 @@ class ApexDQNEpochLoop(RLEpochLoop):
         return self._finalize_results(
             results, self.vec_env.drain_completed_episodes(), start)
 
-    def _greedy_action(self, batched_obs) -> int:
+    def _greedy_actions(self, batched_obs) -> np.ndarray:
+        # epsilon-0 through the learner's sampler so invalid actions stay
+        # masked at selection (Q-logits themselves are unmasked, dqn.py)
         import jax
 
+        B = int(np.asarray(batched_obs["action_mask"]).shape[0])
         actions = self.learner.sample_actions(
             self.state.params, batched_obs, jax.random.PRNGKey(0),
-            np.zeros(1, np.float32))
-        return int(np.asarray(actions)[0])
+            np.zeros(B, np.float32))
+        return np.asarray(actions)
 
 
 # RLlib IMPALA keys (algo/impala.yaml) -> ImpalaConfig fields; Ray queue /
