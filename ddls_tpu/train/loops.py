@@ -396,7 +396,14 @@ class RLEpochLoop:
             np.random.set_state(state[0])
             _random.setstate(state[1])
 
-        envs = [self.make_eval_env() for _ in range(num_episodes)]
+        # env construction is expensive (full cluster/topology build);
+        # reuse across evaluate() calls — env.reset(seed) makes reuse
+        # bit-identical to fresh envs (asserted in tests)
+        cache = getattr(self, "_eval_envs", [])
+        while len(cache) < num_episodes:
+            cache.append(self.make_eval_env())
+        self._eval_envs = cache
+        envs = cache[:num_episodes]
         obs, rng_states = [], []
         for i, env in enumerate(envs):
             obs.append(env.reset(seed=base_seed + i))
@@ -445,9 +452,6 @@ class RLEpochLoop:
         return np.asarray(jax.device_get(
             self._jit_greedy(self.state.params, batched_obs)))
 
-    def _greedy_action(self, batched_obs) -> int:
-        """Greedy action for a [1, ...] obs batch."""
-        return int(self._greedy_actions(batched_obs)[0])
 
     # ----------------------------------------------------------- checkpoints
     def save_agent_checkpoint(self, path: str) -> str:
