@@ -64,6 +64,7 @@ class RampJobPartitioningEnvironment:
                  save_cluster_data: bool = False,
                  save_freq: int = 1,
                  use_sqlite_database: bool = False,
+                 use_jax_lookahead: bool = False,
                  apply_action_mask: bool = True,
                  **kwargs):
         self.topology_config = topology_config
@@ -83,6 +84,7 @@ class RampJobPartitioningEnvironment:
             path_to_save=path_to_save if save_cluster_data else None,
             save_freq=save_freq,
             use_sqlite_database=use_sqlite_database,
+            use_jax_lookahead=use_jax_lookahead,
             suppress_warnings=suppress_warnings)
 
         self.max_partitions_per_op = (

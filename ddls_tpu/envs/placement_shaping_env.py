@@ -68,6 +68,7 @@ class RampJobPlacementShapingEnvironment:
                  save_cluster_data: bool = False,
                  save_freq: int = 1,
                  use_sqlite_database: bool = False,
+                 use_jax_lookahead: bool = False,
                  apply_action_mask: bool = True,
                  **kwargs):
         self.topology_config = topology_config
@@ -85,7 +86,8 @@ class RampJobPlacementShapingEnvironment:
             node_config=node_config,
             path_to_save=path_to_save if save_cluster_data else None,
             save_freq=save_freq,
-            use_sqlite_database=use_sqlite_database)
+            use_sqlite_database=use_sqlite_database,
+            use_jax_lookahead=use_jax_lookahead)
 
         if observation_function != "ramp_job_placement_shaping_observation":
             raise ValueError(

@@ -62,9 +62,12 @@ class RampClusterEnvironment:
                  save_freq: int = 1,
                  use_sqlite_database: bool = False,
                  suppress_warnings: bool = True,
+                 use_jax_lookahead: bool = False,
                  machine_epsilon: float = 1e-7):
         self.name = name
         self.use_sqlite_database = use_sqlite_database
+        # opt-in array-engine lookahead backend (docs/jax_lookahead_gonogo.md)
+        self.use_jax_lookahead = use_jax_lookahead
         self.machine_epsilon = machine_epsilon
         self.suppress_warnings = suppress_warnings
         self.save_freq = save_freq
@@ -188,9 +191,10 @@ class RampClusterEnvironment:
     # ---------------------------------------------------------------- lookahead
     def _run_lookahead(self, job: Job):
         """Simulate one training step of a freshly mounted job; returns
-        (jct, comm_overhead, comp_overhead, tick_profile) where the first
-        three are scaled by num_training_steps and tick_profile is a list of
-        (active_workers, tick_size) for the single simulated step."""
+        (jct, comm_overhead, comp_overhead, busy) where the first three are
+        scaled by num_training_steps and ``busy`` is the worker-busy time
+        integral (sum of active-worker count x tick) of the single
+        simulated step."""
         job_idx = job.details["job_idx"]
         state = job.reset_training_step()
         graph = job.graph
@@ -224,8 +228,7 @@ class RampClusterEnvironment:
               for dep in sorted(ch.mounted_job_idx_to_deps[job_idx])])
             for ch in channels_with_job]
 
-        t = comm_oh = comp_oh = 0.0
-        tick_profile: List[Tuple[int, float]] = []
+        t = comm_oh = comp_oh = busy = 0.0
         guard = 0
         while True:
             guard += 1
@@ -323,7 +326,7 @@ class RampClusterEnvironment:
             elif ticked_ops:
                 comp_oh += tick
 
-            tick_profile.append((active_workers, tick))
+            busy += active_workers * tick
             t += tick
 
             if state.is_training_step_complete():
@@ -331,7 +334,7 @@ class RampClusterEnvironment:
                 break
 
         steps = job.num_training_steps
-        return t * steps, comm_oh * steps, comp_oh * steps, tick_profile
+        return t * steps, comm_oh * steps, comp_oh * steps, busy
 
     def _lookahead_cache_key(self, job: Job, job_id: int) -> tuple:
         """A signature that fully determines the lookahead outcome.
@@ -364,15 +367,54 @@ class RampClusterEnvironment:
             key = self._lookahead_cache_key(job, job_id)
             cached = self.lookahead_cache.get(key)
             if cached is None:
-                cached = self._run_lookahead(job)
+                if self.use_jax_lookahead:
+                    cached = self._run_jax_lookahead(job)
+                if cached is None:  # disabled, or padding/shape fallback
+                    cached = self._run_lookahead(job)
                 self.lookahead_cache[key] = cached
-            jct, comm_oh, comp_oh, tick_profile = cached
+            jct, comm_oh, comp_oh, busy = cached
             self._register_completed_lookahead(job, jct, comm_oh, comp_oh,
-                                               tick_profile)
+                                               busy)
+
+    def _run_jax_lookahead(self, job: Job):
+        """Cache-miss lookahead on the jitted array engine (opt-in;
+        docs/jax_lookahead_gonogo.md). Pads op/dep counts up to power-of-two
+        buckets so distinct jobs share compiled kernels; returns None to
+        fall back to the host engine when assembly fails (e.g. more
+        channels per flow than the pad allows)."""
+        from ddls_tpu.sim.jax_lookahead import (arrays_as_args,
+                                                build_lookahead_arrays,
+                                                lookahead_fn)
+
+        def bucket(n: int) -> int:
+            size = 16
+            while size < n:
+                size *= 2
+            return size
+
+        try:
+            arrays = build_lookahead_arrays(
+                job=job, cluster=self,
+                pad_ops=bucket(job.graph.n_ops),
+                pad_deps=bucket(job.graph.n_deps),
+                pad_links=2)
+        except ValueError:
+            # padding overflow only; bookkeeping errors (KeyError) must
+            # crash as loudly as they would on the host path
+            return None
+        fn = lookahead_fn(arrays.num_workers, arrays.num_channels)
+        t, comm, comp, busy, ok = (float(x) for x in fn(
+            *arrays_as_args(arrays)))
+        if not ok:
+            raise RuntimeError(
+                f"jax lookahead failed to converge for job {job.job_id} "
+                "(engine bug)")
+        steps = job.num_training_steps
+        return t * steps, comm * steps, comp * steps, busy
 
     def _register_completed_lookahead(self, job: Job, jct: float,
                                       comm_oh: float, comp_oh: float,
-                                      tick_profile) -> None:
+                                      busy: float) -> None:
         """(reference: :793-892)"""
         if jct > job.max_acceptable_jct:
             # SLA violated: block the original job, unmount the partitioned one
@@ -382,14 +424,11 @@ class RampClusterEnvironment:
             self._remove_job_from_cluster(job)
             return
 
-        # tick_profile covers ONE training step; normalise by the single-step
+        # busy covers ONE training step; normalise by the single-step
         # time (jct / num_training_steps), not the full scaled JCT
         n_mounted = max(len(job.details["mounted_workers"]), 1)
         step_time = jct / max(job.num_training_steps, 1)
-        util = 0.0
-        for active, tick in tick_profile:
-            util += ((active / n_mounted) * (tick / step_time)
-                     if step_time > 0 else 0.0)
+        util = busy / (n_mounted * step_time) if step_time > 0 else 0.0
 
         job.details["lookahead_job_completion_time"] = jct
         job.details["communication_overhead_time"] = comm_oh

@@ -31,6 +31,7 @@ static shapes; invalid slots carry ``valid=False`` masks.
 from __future__ import annotations
 
 import dataclasses
+from functools import lru_cache as _lru_cache
 from typing import Dict, Tuple
 
 import numpy as np
@@ -157,10 +158,13 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
                   dep_remaining, dep_valid, dep_src, dep_dst, dep_mutual,
                   dep_is_flow, dep_score, dep_channel,
                   *, num_workers: int, num_channels: int):
-    """One-training-step lookahead; returns (t, comm_oh, comp_oh, ok).
+    """One-training-step lookahead; returns (t, comm_oh, comp_oh, busy, ok).
 
-    Pure function of arrays — jit/vmap-friendly. ``ok`` is False when the
-    engine could not progress (the host raises in that case).
+    ``busy`` is the worker-busy time integral (sum over ticks of
+    active-worker count x tick), the quantity utilisation stats divide by
+    mounted-worker count x step time. Pure function of arrays —
+    jit/vmap-friendly. ``ok`` is False when the engine could not progress
+    (the host raises in that case).
     """
     import jax
     import jax.numpy as jnp
@@ -173,14 +177,14 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
                      .T)  # [W, N]; -1 (padding) one-hots to zeros
 
     def cond(state):
-        (_, _, op_done, dep_done, _, _, _, _, it, stuck) = state
+        (_, _, op_done, dep_done, _, _, _, _, _, it, stuck) = state
         all_done = (jnp.all(op_done | ~op_valid)
                     & jnp.all(dep_done | ~dep_valid))
         return (~all_done) & (it < max_iters) & (~stuck)
 
     def body(state):
         (rem_op, rem_dep, op_done, dep_done, parent_done,
-         t, comm_oh, comp_oh, it, stuck) = state
+         t, comm_oh, comp_oh, busy, it, stuck) = state
 
         # 1. readiness (snapshotted BEFORE this tick's completions)
         ops_ready = op_valid & ~op_done & (parent_done >= num_parents)
@@ -243,25 +247,34 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
         safe_tick = jnp.where(new_stuck, 0.0, tick)
         comp_oh2 = comp_oh + jnp.where(ticked_ops, safe_tick, 0.0)
         comm_oh2 = comm_oh + jnp.where(ticked_flows, safe_tick, 0.0)
+        busy2 = busy + safe_tick * jnp.sum(sel_ops).astype(jnp.float32)
         t2 = t + safe_tick
 
         return (rem_op2, rem_dep2, op_done2, dep_done2, parent_done2,
-                t2, comm_oh2, comp_oh2, it + 1, stuck | new_stuck)
+                t2, comm_oh2, comp_oh2, busy2, it + 1, stuck | new_stuck)
 
     init = (op_remaining, dep_remaining,
             jnp.zeros((N,), bool), jnp.zeros((E,), bool),
             jnp.zeros((N,), jnp.int32),
             jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
-            jnp.int32(0), jnp.bool_(False))
+            jnp.float32(0.0), jnp.int32(0), jnp.bool_(False))
     out = jax.lax.while_loop(cond, body, init)
-    (_, _, op_done, dep_done, _, t, comm_oh, comp_oh, it, stuck) = out
+    (_, _, op_done, dep_done, _, t, comm_oh, comp_oh, busy, it,
+     stuck) = out
     finished = (jnp.all(op_done | ~op_valid)
                 & jnp.all(dep_done | ~dep_valid))
-    return t, comm_oh, comp_oh, finished & ~stuck
+    return t, comm_oh, comp_oh, busy, finished & ~stuck
 
 
-def lookahead_fn(num_workers: int, num_channels: int, pad_links: int = 1):
-    """Jitted single-job lookahead closure over static sizes."""
+def lookahead_fn(num_workers: int, num_channels: int):
+    """Jitted single-job lookahead closure over static sizes (memoised
+    process-wide: identical (workers, channels) share one trace; array
+    shapes further specialise inside jax's own jit cache)."""
+    return _lookahead_fn_cached(num_workers, num_channels)
+
+
+@_lru_cache(maxsize=None)
+def _lookahead_fn_cached(num_workers: int, num_channels: int):
     import jax
     from functools import partial
 

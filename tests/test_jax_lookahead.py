@@ -43,13 +43,14 @@ def _collect_cases(env, actions, n_cases):
     orig = cluster._run_lookahead
 
     def spy(job):
-        jct, comm, comp, profile = orig(job)
+        jct, comm, comp, busy = orig(job)
         steps = job.num_training_steps
         arrays = build_lookahead_arrays(cluster, job, pad_ops=160,
                                         pad_deps=520, pad_links=2)
         cases.append({"host": (jct / steps, comm / steps, comp / steps),
+                      "host_busy": busy,
                       "arrays": arrays})
-        return jct, comm, comp, profile
+        return jct, comm, comp, busy
 
     cluster._run_lookahead = spy
     try:
@@ -85,13 +86,15 @@ def test_matches_host_engine(dataset_dir, actions):
         a = case["arrays"]
         key = (a.num_workers, a.num_channels)
         fn = fns.setdefault(key, lookahead_fn(*key))
-        t, comm, comp, ok = fn(*arrays_as_args(a))
+        t, comm, comp, busy, ok = fn(*arrays_as_args(a))
         assert bool(ok), "array engine failed to converge"
         host_t, host_comm, host_comp = case["host"]
         assert float(t) == pytest.approx(host_t, rel=1e-4), \
             f"jct mismatch: jax {float(t)} vs host {host_t}"
         assert float(comm) == pytest.approx(host_comm, rel=1e-4, abs=1e-6)
         assert float(comp) == pytest.approx(host_comp, rel=1e-4, abs=1e-6)
+        assert float(busy) == pytest.approx(case["host_busy"], rel=1e-4,
+                                            abs=1e-6)
 
 
 def test_vmapped_batch(dataset_dir):
@@ -107,7 +110,37 @@ def test_vmapped_batch(dataset_dir):
     fn = batched_lookahead_fn(W, C)
     batch = [np.stack([arrays_as_args(c["arrays"])[k] for c in cases])
              for k in range(13)]
-    t, comm, comp, ok = fn(*batch)
+    t, comm, comp, busy, ok = fn(*batch)
     assert bool(np.all(ok))
     for bi, case in enumerate(cases):
         assert float(t[bi]) == pytest.approx(case["host"][0], rel=1e-4)
+
+
+def test_cluster_opt_in_backend_matches_host(dataset_dir):
+    """use_jax_lookahead=True: a full episode's outcomes (JCTs, blocking,
+    overheads, utilisation) match the host engine's episode to f32
+    precision (docs/jax_lookahead_gonogo.md integration)."""
+    episodes = {}
+    for use_jax in (False, True):
+        env = _make_env(dataset_dir)
+        env.cluster.use_jax_lookahead = use_jax
+        obs = env.reset(seed=0)
+        done, steps = False, 0
+        while not done and steps < 60:
+            mask = np.asarray(obs["action_mask"])
+            a = int(np.nonzero(mask)[0][-1])  # max parallelism: misses cache
+            obs, _, done, _ = env.step(a)
+            steps += 1
+        episodes[use_jax] = env.cluster.episode_stats
+
+    host, jaxe = episodes[False], episodes[True]
+    assert jaxe["num_jobs_completed"] == host["num_jobs_completed"]
+    assert jaxe["num_jobs_blocked"] == host["num_jobs_blocked"]
+    assert jaxe["job_completion_time"] == pytest.approx(
+        host["job_completion_time"], rel=1e-4)
+    assert jaxe["job_communication_overhead_time"] == pytest.approx(
+        host["job_communication_overhead_time"], rel=1e-4, abs=1e-6)
+    assert jaxe["jobs_completed_mean_mounted_worker_utilisation_frac"] == (
+        pytest.approx(
+            host["jobs_completed_mean_mounted_worker_utilisation_frac"],
+            rel=1e-4))
