@@ -215,16 +215,23 @@ def run_bench(args, platform_note: str | None) -> dict:
         return state, out["env_steps"]
 
     rng = jax.random.PRNGKey(1)
+    bench_start = time.perf_counter()
     for i in range(args.warmup_epochs):
         rng, sub = jax.random.split(rng)
         state, _ = one_epoch(state, sub)
 
     t0 = time.perf_counter()
     total_steps = 0
+    epochs_run = 0
     for i in range(args.timed_epochs):
         rng, sub = jax.random.split(rng)
         state, n = one_epoch(state, sub)
         total_steps += n
+        epochs_run += 1
+        # a measurement must always land inside the driver's budget: stop
+        # early (with >=1 timed epoch recorded) rather than get killed
+        if time.perf_counter() - bench_start > args.budget_seconds:
+            break
     dt = time.perf_counter() - t0
 
     vec.close()
@@ -237,6 +244,9 @@ def run_bench(args, platform_note: str | None) -> dict:
         "baseline_source": BASELINE_SOURCE,
         "platform": jax.devices()[0].platform,
         "num_envs": args.num_envs,  # after device-multiple rounding
+        "rollout_length": args.rollout_length,
+        "num_sgd_iter": args.num_sgd_iter,
+        "timed_epochs": epochs_run,
         "cores": _available_cores(),
     }
     if platform_note:
@@ -255,6 +265,9 @@ def main(argv=None) -> int:
     parser.add_argument("--num-sgd-iter", type=int, default=50)
     parser.add_argument("--sim-seconds", type=float, default=20.0)
     parser.add_argument("--probe-timeout", type=float, default=240.0)
+    parser.add_argument("--budget-seconds", type=float, default=420.0,
+                        help="stop timing epochs past this wall-clock "
+                             "budget so a JSON line always lands")
     args = parser.parse_args(argv)
     if args.num_envs is None:
         cores = _available_cores()
@@ -296,6 +309,15 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu" or platform_note:
+        # CPU (explicit or fallback) is a smoke measurement, not the
+        # headline: the scanned SGD update alone takes minutes at full size
+        # on one host core, so shrink to something that completes
+        args.num_envs = min(args.num_envs, 4)
+        args.rollout_length = min(args.rollout_length, 16)
+        args.timed_epochs = min(args.timed_epochs, 2)
+        args.num_sgd_iter = min(args.num_sgd_iter, 10)
 
     try:
         emit(run_bench(args, platform_note))
