@@ -2,10 +2,12 @@
 global mesh; shard_batch assembles per-process rollout shards and the jitted
 update all-reduces gradients across hosts (SURVEY.md §5.8 TPU-native
 equivalent of the reference's Ray worker topology)."""
+import glob
 import os
 import socket
 import subprocess
 import sys
+from typing import List
 
 import pytest
 
@@ -28,27 +30,71 @@ def _worker_env() -> dict:
     return env
 
 
-def test_two_process_global_mesh():
-    port = _free_port()
-    coordinator = f"localhost:{port}"
+def _run_lockstep(argvs: List[List[str]], timeout: float):
+    """Launch one process per argv in lockstep; returns (procs, outputs).
+
+    On timeout every child is killed AND reaped before failing, so no
+    zombies or stale coordinator sockets leak into later tests."""
     env = _worker_env()
-    procs = [
-        subprocess.Popen(
-            [sys.executable, WORKER, coordinator, "2", str(i), REPO],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env)
-        for i in range(2)
-    ]
+    procs = [subprocess.Popen(argv, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for argv in argvs]
     outputs = []
     for proc in procs:
         try:
-            out, _ = proc.communicate(timeout=180)
+            out, _ = proc.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for p in procs:
                 p.kill()
-            pytest.fail("distributed workers timed out")
+            for p in procs:
+                p.wait()
+            pytest.fail("distributed processes timed out")
         outputs.append(out)
+    return procs, outputs
+
+
+def test_two_process_global_mesh():
+    coordinator = f"localhost:{_free_port()}"
+    procs, outputs = _run_lockstep(
+        [[sys.executable, WORKER, coordinator, "2", str(i), REPO]
+         for i in range(2)], timeout=180)
     for i, (proc, out) in enumerate(zip(procs, outputs)):
         assert proc.returncode == 0, f"worker {i} failed:\n{out}"
         assert "global_devices=4" in out, out
         assert f"UPDATE process={i} w=1.300000" in out, out
+
+
+def test_two_process_training_cli(tmp_path):
+    """The full multi-host path through the real CLI: 2 CPU processes x 2
+    virtual devices train PPO for 1 epoch over one global mesh; only the
+    primary writes artifacts."""
+    port = _free_port()
+    script = os.path.join(REPO, "scripts", "train_from_config.py")
+    overrides = [
+        "launcher.num_epochs=1", "epoch_loop.num_envs=2",
+        "epoch_loop.rollout_length=4", "epoch_loop.use_parallel_envs=false",
+        "eval_config.evaluation_interval=null",
+        "env_config.jobs_config.replication_factor=2",
+        "env_config.jobs_config.job_sampling_mode=remove",
+        "env_config.jobs_config.synthetic.n_cnn=1",
+        "env_config.jobs_config.synthetic.n_translation=1",
+        "env_config.pad_obs_kwargs.max_nodes=32",
+        "env_config.pad_obs_kwargs.max_edges=64",
+        "algo.algo_config.num_sgd_iter=2",
+        f"experiment.path_to_save={tmp_path}",
+        "distributed.enabled=true",
+        f"distributed.coordinator_address=localhost:{port}",
+        "distributed.num_processes=2", "distributed.platform=cpu",
+    ]
+    procs, outputs = _run_lockstep(
+        [[sys.executable, script] + overrides
+         + [f"distributed.process_id={i}"] for i in range(2)],
+        timeout=420)
+    for i, (proc, out) in enumerate(zip(procs, outputs)):
+        assert proc.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+        assert f"process {i}/2" in out
+        assert "Run complete: 1 epochs" in out
+    # primary-only artifacts
+    assert "Experiment save dir" in outputs[0]
+    assert "Experiment save dir" not in outputs[1]
+    assert glob.glob(str(tmp_path / "**" / "results.*"), recursive=True)
