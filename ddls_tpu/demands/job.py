@@ -224,6 +224,16 @@ class Job:
         if self.state is not None:
             self.state.set_dep_init_run_time(edge, run_time)
 
+    def set_dep_init_run_times_bulk(self, times) -> None:
+        """Set every dep's initial run time from an array aligned with
+        ``graph.edge_ids`` order (the hot path prices all deps at once)."""
+        self.dep_init_run_time = {
+            edge: float(t) for edge, t in zip(self.graph.edge_ids, times)}
+        if self.state is not None:
+            arr = np.asarray(times, dtype=np.float64)
+            self.state.init_dep_run_time[:] = arr
+            self.state.remaining_dep[:] = arr
+
     def register_arrived(self, time_arrived: float, job_idx: int) -> None:
         self.details["time_arrived"] = time_arrived
         self.details["time_started"] = None

@@ -36,6 +36,9 @@ class OpPartition:
         self.partitioned_jobs: Dict[int, Job] = {}
         self.job_id_to_max_partition_degree: Dict[int, int] = defaultdict(lambda: 1)
         self.job_id_to_split_forward_ops: Dict[int, Dict[str, int]] = {}
+        # partition-cache entries, so dep pricing can reuse/memoise the
+        # per-graph collective grouping arrays
+        self.job_id_to_cache_entry: Dict[int, dict] = {}
 
         for job_id, op_to_n in self.action.items():
             for op_id, n in op_to_n.items():
@@ -74,6 +77,7 @@ class OpPartition:
                 cached = {"graph": pgraph, "immutable": None}
                 cluster.partition_cache[cache_key] = cached
             pgraph = cached["graph"]
+            self.job_id_to_cache_entry[job_id] = cached
 
             details = {"model": model,
                        "job_idx": job.details.get("job_idx"),
@@ -305,13 +309,81 @@ def group_collectives(original_job: Job,
     return candidate_groups, sync_groups, one_to_one
 
 
+def build_grouping_arrays(original: Job, partitioned: Job,
+                          split_fwd: Dict[str, int]) -> dict:
+    """Index-array form of the collective grouping, static per partitioned
+    graph and therefore memoised alongside it in the cluster's partition
+    cache (pricing then touches numpy arrays, not per-edge dicts)."""
+    import numpy as np
+
+    cand, sync, o2o = group_collectives(original, partitioned, split_fwd)
+    arrays = partitioned.graph.finalize()
+    eidx, oidx = arrays["edge_index"], arrays["op_index"]
+    sizes = arrays["edge_size"]
+
+    def pack(group, is_sync):
+        e = np.fromiter((eidx[d] for d in group), np.int64, len(group))
+        u = np.fromiter((oidx[d[0]] for d in group), np.int64, len(group))
+        v = np.fromiter((oidx[d[1]] for d in group), np.int64, len(group))
+        # plain-list mirrors: groups are mostly tiny (2-edge sync pairs),
+        # where Python set/sort constants beat numpy's per-call overhead
+        return {"edges": e, "u": u, "v": v,
+                "u_list": u.tolist(), "v_list": v.tolist(),
+                "msg": float(sizes[e].sum()), "sync": is_sync}
+
+    return {
+        "groups": ([pack(g, False) for g in cand]
+                   + [pack(g, True) for g in sync]),
+        "o2o_edges": np.fromiter((eidx[d] for d in o2o), np.int64,
+                                 len(o2o)),
+        "o2o_u": np.fromiter((oidx[d[0]] for d in o2o), np.int64, len(o2o)),
+        "o2o_v": np.fromiter((oidx[d[1]] for d in o2o), np.int64, len(o2o)),
+    }
+
+
+def _server_code_tables(cluster):
+    """server_id -> dense code, plus (comm group, rack, server) component
+    lists indexed by code; built once per cluster (the topology is fixed
+    for its lifetime) and stored with the cluster's other memo caches."""
+    tables = cluster._server_code_tables
+    if tables is None:
+        ids = cluster.topology.server_ids
+        code = {sid: i for i, sid in enumerate(ids)}
+        parts = [[0, 0, 0] for _ in ids]
+        for i, sid in enumerate(ids):
+            for axis, val in enumerate(sid.split("-")[:3]):
+                parts[i][axis] = int(val)
+        tables = (code,
+                  [p[0] for p in parts],
+                  [p[1] for p in parts],
+                  [p[2] for p in parts])
+        cluster._server_code_tables = tables
+    return tables
+
+
 def assign_dep_run_times(cluster, op_partition: OpPartition,
                          op_placement: "OpPlacement") -> None:
     """Price every dep of every placed job given op placements and topology
-    (reference: actions/utils.py:13-167)."""
+    (reference: actions/utils.py:13-167).
+
+    Array formulation of the reference's per-edge walk: the grouping is a
+    cached index-array structure, placements become a dense op->server-code
+    vector, symmetry tests are sorted-array comparisons, and all one-to-one
+    deps are priced in one vectorised expression.
+    """
+    import numpy as np
+
     if not op_placement.job_ids:
         return
     topo = cluster.topology
+    code, c_list, r_list, s_list = _server_code_tables(cluster)
+    span_cache = cluster._span_cache
+    worker_to_server = topo.worker_to_server
+    rate = topo.channel_bandwidth
+    prop = topo.intra_gpu_propagation_latency
+    io = topo.worker_io_latency
+    allreduce_cache = cluster.comm_time_cache
+
     for job_id in op_partition.action:
         if job_id not in op_placement.action:
             continue
@@ -320,70 +392,63 @@ def assign_dep_run_times(cluster, op_partition: OpPartition,
         placement = op_placement.action[job_id]
         split_fwd = op_partition.job_id_to_split_forward_ops[job_id]
 
-        candidate_groups, sync_groups, o2o = group_collectives(
-            original, partitioned, split_fwd)
+        cache_entry = op_partition.job_id_to_cache_entry.get(job_id)
+        grouping = (cache_entry or {}).get("grouping")
+        if grouping is None:
+            grouping = build_grouping_arrays(original, partitioned,
+                                             split_fwd)
+            if cache_entry is not None:
+                cache_entry["grouping"] = grouping
 
-        # hot path: one lookup per op instead of two chained lookups per edge
-        # endpoint, and dict-based comm-time memoisation per topology (sync
-        # cliques price hundreds of identically-shaped 2-edge collectives)
-        worker_to_server = topo.worker_to_server
-        op_server = {op_id: worker_to_server[w]
-                     for op_id, w in placement.items()}
-        edge_size = partitioned.graph.edge_size
-        set_run_time = partitioned.set_dep_init_run_time
-        allreduce_cache = cluster.comm_time_cache
+        arrays = partitioned.graph.finalize()
+        sc_list = [code[worker_to_server[placement[op]]]
+                   for op in arrays["op_ids"]]
+        sc = np.asarray(sc_list, np.int64)
 
-        collectives: List[List[EdgeId]] = list(sync_groups)
-        for group in candidate_groups:
+        times = np.zeros(partitioned.graph.n_deps, np.float64)
+        extra_e, extra_u, extra_v = [], [], []
+        for group in grouping["groups"]:
+            u_codes = [sc_list[i] for i in group["u_list"]]
+            v_codes = [sc_list[i] for i in group["v_list"]]
             # placement-symmetric parent/child multisets -> true collective
-            parent_servers = sorted(op_server[u] for u, _ in group)
-            child_servers = sorted(op_server[v] for _, v in group)
-            if parent_servers == child_servers:
-                collectives.append(group)
-            else:
-                o2o = o2o + group
-
-        for group in collectives:
-            servers = set()
-            message_size = 0.0
-            for u, v in group:
-                servers.add(op_server[u])
-                servers.add(op_server[v])
-                message_size += edge_size(u, v)
+            if not group["sync"] and sorted(u_codes) != sorted(v_codes):
+                extra_e.append(group["edges"])
+                extra_u.append(group["u"])
+                extra_v.append(group["v"])
+                continue
+            servers = frozenset(u_codes).union(v_codes)
             if len(servers) == 1:
                 run_time = 0.0
             else:
-                cgs, racks, srv_ids = set(), set(), set()
-                for sid in servers:
-                    c, r, s = sid.split("-")
-                    cgs.add(c)
-                    racks.add(r)
-                    srv_ids.add(s)
-                key = (message_size, len(srv_ids), len(racks), len(cgs))
+                span = span_cache.get(servers)
+                if span is None:
+                    span = (len({s_list[s] for s in servers}),
+                            len({r_list[s] for s in servers}),
+                            len({c_list[s] for s in servers}))
+                    span_cache[servers] = span
+                key = (group["msg"],) + span
                 run_time = allreduce_cache.get(key)
                 if run_time is None:
                     run_time = ramp_all_reduce_time(
-                        message_size=message_size,
-                        num_servers=len(srv_ids),
-                        num_racks=len(racks),
-                        num_comm_groups=len(cgs),
+                        message_size=group["msg"],
+                        num_servers=span[0],
+                        num_racks=span[1],
+                        num_comm_groups=span[2],
                         network_comm_groups=topo.num_communication_groups,
-                        data_rate=topo.channel_bandwidth,
-                        propagation_latency=topo.intra_gpu_propagation_latency,
-                        io_latency=topo.worker_io_latency)
+                        data_rate=rate,
+                        propagation_latency=prop,
+                        io_latency=io)
                     allreduce_cache[key] = run_time
-            for dep in group:
-                set_run_time(dep, run_time)
+            times[group["edges"]] = run_time
 
-        for (u, v) in o2o:
-            if op_server[u] == op_server[v]:
-                run_time = 0.0
-            elif edge_size(u, v) == 0:
-                run_time = 0.0
-            else:
-                run_time = one_to_one_time(
-                    edge_size(u, v),
-                    data_rate=topo.channel_bandwidth,
-                    propagation_latency=topo.intra_gpu_propagation_latency,
-                    io_latency=topo.worker_io_latency)
-            set_run_time((u, v), run_time)
+        o2o_e = np.concatenate([grouping["o2o_edges"]] + extra_e)
+        o2o_u = np.concatenate([grouping["o2o_u"]] + extra_u)
+        o2o_v = np.concatenate([grouping["o2o_v"]] + extra_v)
+        sizes = arrays["edge_size"][o2o_e]
+        free = (sc[o2o_u] == sc[o2o_v]) | (sizes == 0)
+        times[o2o_e] = np.where(free, 0.0, prop + 2 * io + sizes / rate)
+        if not np.all(np.isfinite(times)):
+            raise ValueError(
+                f"non-finite communication time priced for job {job_id}")
+
+        partitioned.set_dep_init_run_times_bulk(times)

@@ -82,6 +82,10 @@ class RampClusterEnvironment:
         self.stopwatch = Stopwatch()
         self.reset_counter = 0
         self._save_thread: Optional[threading.Thread] = None
+        # topology-lifetime pricing caches (populated lazily by
+        # sim.actions): server-id code tables and per-server-set spans
+        self._server_code_tables: Optional[tuple] = None
+        self._span_cache: Dict[frozenset, tuple] = {}
 
     # ------------------------------------------------------------------ reset
     def reset(self,
@@ -536,12 +540,13 @@ class RampClusterEnvironment:
             for op_id, worker_id in op_to_worker.items():
                 worker = self.topology.workers[worker_id]
                 # RAMP rule 1: at most one job per worker
-                other_jobs = set(worker.mounted_job_idx_to_ops) - {job_idx}
-                if other_jobs:
+                if any(idx != job_idx
+                       for idx in worker.mounted_job_idx_to_ops):
                     raise RuntimeError(
                         f"RAMP rule violation: worker {worker_id} already "
-                        f"holds job idx(s) {other_jobs}, cannot mount job "
-                        f"idx {job_idx}")
+                        f"holds job idx(s) "
+                        f"{set(worker.mounted_job_idx_to_ops) - {job_idx}}, "
+                        f"cannot mount job idx {job_idx}")
                 worker.mount(job, op_id)
                 job.details["mounted_workers"].add(worker_id)
                 self.job_op_to_worker[(job_idx, op_id)] = worker_id
@@ -585,11 +590,12 @@ class RampClusterEnvironment:
                         continue
                     channel = self.topology.channel_id_to_channel[ch_id]
                     # RAMP rule 2: at most one job per channel
-                    others = set(channel.mounted_job_idx_to_deps) - {job_idx}
-                    if others:
+                    if any(idx != job_idx
+                           for idx in channel.mounted_job_idx_to_deps):
                         raise RuntimeError(
                             f"RAMP rule violation: channel {ch_id} already "
-                            f"holds job idx(s) {others}")
+                            f"holds job idx(s) "
+                            f"{set(channel.mounted_job_idx_to_deps) - {job_idx}}")
                     channel.mount(job, dep_id)
                     job.details["mounted_channels"].add(ch_id)
                     self.job_dep_to_channels[(job_idx, dep_id)].add(ch_id)
