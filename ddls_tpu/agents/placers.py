@@ -27,6 +27,9 @@ from ddls_tpu.sim.partition import partitioned_op_id
 
 # sentinel distinguishing "pair not scanned yet" from "pair has no options"
 _PAIR_UNSEEN = object()
+# shared marker for non-flow deps (zero size or same server): one tuple
+# object serves every such dep
+_NONFLOW = (None,)
 
 
 def _pair_memory(full_graph, op: str, b_op: str) -> float:
@@ -226,64 +229,84 @@ class FirstFitDepPlacer:
         pass
 
     def get(self, op_partition, op_placement, cluster, verbose: bool = False):
+        import numpy as np
+
         from ddls_tpu.sim.actions import DepPlacement
 
         topo = cluster.topology
         placements = op_placement.action
-        result: Dict[int, Dict[Tuple[str, str], Set[Optional[str]]]] = {}
+        result: Dict[int, Dict[Tuple[str, str], tuple]] = {}
         channels_used_by_other_jobs: Set[str] = set()
+        worker_to_server = topo.worker_to_server
 
         for job_id, partitioned in op_partition.partitioned_jobs.items():
             if job_id not in placements:
                 continue
             job_idx = partitioned.details["job_idx"]
             placement = placements[job_id]
-            worker_to_server = topo.worker_to_server
-            op_server = {op_id: worker_to_server[w]
-                         for op_id, w in placement.items()}
-            edge_size = partitioned.graph.edge_size
-            dep_to_channels: Dict[Tuple[str, str], Set[Optional[str]]] = (
-                defaultdict(set))
-            channels_this_job: Set[str] = set()
+            arrays = partitioned.graph.finalize()
+            op_ids, edge_ids = arrays["op_ids"], arrays["edge_ids"]
+
+            server_of_op = [worker_to_server[placement[op]] for op in op_ids]
+            scode, is_flow = partitioned.graph.flow_mask(server_of_op)
+
+            dep_to_channels: Dict[Tuple[str, str], tuple] = {}
             # channel validity for a (src, dst) pair is fixed while this
             # job's deps are being placed, so scan the path x channel space
             # once per pair: first path with any valid channel + that path's
             # valid channel list. Per dep, a uniform pick from the list is
             # distribution-identical to the reference's shuffled first-fit
             # (first_fit_dep_placer.py:118-121) at O(1) instead of
-            # O(paths x channels) per flow.
-            pair_options: Dict[Tuple[str, str], Optional[tuple]] = {}
+            # O(paths x channels) per flow. The channel-id tuple per
+            # (pair, channel) is materialised once and shared by every dep
+            # riding it (ids are read-only downstream).
+            pair_options: Dict[Tuple[int, int], Optional[tuple]] = {}
             ok = True
-            for dep_id in partitioned.graph.edge_ids:
-                u, v = dep_id
-                src_node = op_server[u]
-                dst_node = op_server[v]
-                if src_node == dst_node or edge_size(u, v) == 0:
-                    dep_to_channels[dep_id].add(None)
-                    continue
-                key = (src_node, dst_node)
+            for ei in np.nonzero(~is_flow)[0]:
+                dep_to_channels[edge_ids[ei]] = _NONFLOW
+            for ei in np.nonzero(is_flow)[0]:
+                u, v = edge_ids[ei]
+                si, di = scode[arrays["edge_src"][ei]], scode[
+                    arrays["edge_dst"][ei]]
+                key = (si, di)
                 options = pair_options.get(key, _PAIR_UNSEEN)
                 if options is _PAIR_UNSEEN:
-                    options = self._valid_path_channels(
-                        topo, src_node, dst_node, job_idx,
+                    found = self._valid_path_channels(
+                        topo, server_of_op[arrays["edge_src"][ei]],
+                        server_of_op[arrays["edge_dst"][ei]], job_idx,
                         channels_used_by_other_jobs)
+                    if found is None:
+                        options = None
+                    else:
+                        path, valid_channels = found
+                        by_ch = {}
+                        for ch_num in valid_channels:
+                            by_ch[ch_num] = tuple(
+                                make_channel_id(path[idx], path[idx + 1],
+                                                ch_num)
+                                for idx in range(len(path) - 1))
+                        options = (valid_channels, by_ch, set())
                     pair_options[key] = options
                 if options is None:
                     ok = False
                     break
-                path, valid_channels = options
+                valid_channels, by_ch, chosen = options
                 # single-channel topologies (the canonical RAMP config) skip
                 # the uniform pick — random.choice dominates this loop at
                 # ~1.5k placed deps per env step otherwise
                 ch_num = (valid_channels[0] if len(valid_channels) == 1
                           else random.choice(valid_channels))
-                for idx in range(len(path) - 1):
-                    ch_id = make_channel_id(path[idx], path[idx + 1], ch_num)
-                    dep_to_channels[dep_id].add(ch_id)
-                    channels_this_job.add(ch_id)
+                dep_to_channels[edge_ids[ei]] = by_ch[ch_num]
+                chosen.add(ch_num)
             if ok:
-                result[job_id] = dict(dep_to_channels)
-                channels_used_by_other_jobs.update(channels_this_job)
+                result[job_id] = dep_to_channels
+                # commit exactly the channels this job's deps ride (feeds the
+                # next job's validity scans within this composite action)
+                for options in pair_options.values():
+                    if options is not None:
+                        _, by_ch, chosen = options
+                        for ch_num in chosen:
+                            channels_used_by_other_jobs.update(by_ch[ch_num])
         return DepPlacement(result)
 
     def _valid_path_channels(self, topo, src_node: str, dst_node: str,

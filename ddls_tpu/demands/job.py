@@ -204,6 +204,8 @@ class Job:
         # placement; survives training-step resets (the reference keeps
         # these as edge 'init_run_time' attributes, job.py:461-464)
         self.dep_init_run_time: Dict[EdgeId, float] = {}
+        # aligned-array mirror (graph.edge_ids order); None when stale
+        self.dep_init_run_time_arr = None
         self.training_step_counter = 0
         self.original_job = original_job if original_job is not None else self
 
@@ -221,6 +223,7 @@ class Job:
 
     def set_dep_init_run_time(self, edge: EdgeId, run_time: float) -> None:
         self.dep_init_run_time[edge] = float(run_time)
+        self.dep_init_run_time_arr = None  # single-edge write: mirror stale
         if self.state is not None:
             self.state.set_dep_init_run_time(edge, run_time)
 
@@ -229,6 +232,8 @@ class Job:
         ``graph.edge_ids`` order (the hot path prices all deps at once)."""
         self.dep_init_run_time = {
             edge: float(t) for edge, t in zip(self.graph.edge_ids, times)}
+        # aligned-array mirror for the native/array engines' packers
+        self.dep_init_run_time_arr = np.asarray(times, np.float64).copy()
         if self.state is not None:
             arr = np.asarray(times, dtype=np.float64)
             self.state.init_dep_run_time[:] = arr

@@ -65,6 +65,7 @@ class RampJobPartitioningEnvironment:
                  save_freq: int = 1,
                  use_sqlite_database: bool = False,
                  use_jax_lookahead: bool = False,
+                 use_native_lookahead: str | bool = "auto",
                  apply_action_mask: bool = True,
                  **kwargs):
         self.topology_config = topology_config
@@ -85,6 +86,7 @@ class RampJobPartitioningEnvironment:
             save_freq=save_freq,
             use_sqlite_database=use_sqlite_database,
             use_jax_lookahead=use_jax_lookahead,
+            use_native_lookahead=use_native_lookahead,
             suppress_warnings=suppress_warnings)
 
         self.max_partitions_per_op = (

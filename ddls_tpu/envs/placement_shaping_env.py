@@ -69,6 +69,7 @@ class RampJobPlacementShapingEnvironment:
                  save_freq: int = 1,
                  use_sqlite_database: bool = False,
                  use_jax_lookahead: bool = False,
+                 use_native_lookahead: str | bool = "auto",
                  apply_action_mask: bool = True,
                  **kwargs):
         self.topology_config = topology_config
@@ -87,7 +88,8 @@ class RampJobPlacementShapingEnvironment:
             path_to_save=path_to_save if save_cluster_data else None,
             save_freq=save_freq,
             use_sqlite_database=use_sqlite_database,
-            use_jax_lookahead=use_jax_lookahead)
+            use_jax_lookahead=use_jax_lookahead,
+            use_native_lookahead=use_native_lookahead)
 
         if observation_function != "ramp_job_placement_shaping_observation":
             raise ValueError(

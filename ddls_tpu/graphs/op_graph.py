@@ -212,6 +212,17 @@ class OpGraph:
         sources = [op for op in op_ids if len(self._pred[op]) == 0]
         depth = self._bfs_depths(sources[0] if sources else None, op_index, n)
 
+        # sorted-id ranks: the engines break priority ties to the smallest
+        # op/edge id; precomputing them here (cached per graph, and graphs
+        # are memoised across same-model jobs) keeps lookahead packing off
+        # the per-call hot path
+        op_sorted_rank = np.empty(n, dtype=np.int64)
+        for r, op in enumerate(sorted(op_ids)):
+            op_sorted_rank[op_index[op]] = r
+        edge_sorted_rank = np.empty(m, dtype=np.int64)
+        for r, e in enumerate(sorted(edge_ids)):
+            edge_sorted_rank[edge_index[e]] = r
+
         self._cache = {
             "op_ids": op_ids,
             "edge_ids": edge_ids,
@@ -229,8 +240,32 @@ class OpGraph:
             "edge_mutual": edge_mutual,
             "sources": sources,
             "depth": depth,
+            "op_sorted_rank": op_sorted_rank,
+            "edge_sorted_rank": edge_sorted_rank,
         }
         return self._cache
+
+    def flow_mask(self, server_of_op) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense per-op server codes + per-dep flow mask.
+
+        ``server_of_op`` is a sequence of server ids aligned with
+        ``finalize()['op_ids']``. A dep is a *flow* iff its size is nonzero
+        and its endpoints sit on different servers — the single definition
+        shared by the dep placer, the lookahead packers, and the
+        register-time run-time zeroing (which must all agree for the
+        engines to stay in lockstep). Returns (scode[n_ops],
+        is_flow[n_deps])."""
+        arrays = self.finalize()
+        server_dense: Dict[str, int] = {}
+        scode = np.empty(self.n_ops, np.int64)
+        for i, s in enumerate(server_of_op):
+            si = server_dense.get(s)
+            if si is None:
+                si = server_dense.setdefault(s, len(server_dense))
+            scode[i] = si
+        is_flow = ((arrays["edge_size"] > 0)
+                   & (scode[arrays["edge_src"]] != scode[arrays["edge_dst"]]))
+        return scode, is_flow
 
     def _bfs_depths(self, root: Optional[str], op_index: Dict[str, int], n: int) -> np.ndarray:
         """Shortest-path node counts from the first source op; 0 if unreachable

@@ -1,0 +1,133 @@
+"""Native (C++) hot-path kernels for the cluster simulator.
+
+The reference delegates its accelerated work to torch/DGL/Ray
+(SURVEY.md §2.9); its simulator hot loop is pure Python. This package is
+the TPU-framework counterpart for the *host* side of that loop: the
+per-step kernels that dominate env.step wall-clock (the lookahead tick
+engine first — cluster.py:_run_lookahead) implemented in C++ with flat
+array interfaces, loaded via ctypes (no pybind11 in the image).
+
+The library is compiled lazily with g++ on first use and cached under
+``_build/``; every entry point degrades gracefully (returns None /
+``native_available() is False``) when no toolchain is present, so the
+Python engines remain the source of truth and the fallback.
+
+Contract: kernels are bit-exact with the host engines (f64, identical
+operation order) — golden stats tests must pass unchanged with the native
+path enabled.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "engine.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_LIB = os.path.join(_BUILD_DIR, "libddls_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+_f64 = np.ctypeslib.ndpointer(dtype=np.float64, flags="C_CONTIGUOUS")
+_i32 = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
+_u8 = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+
+
+def _compile() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    if (os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+        return True
+    # per-pid temp + atomic replace: concurrent first-use across processes
+    # (parallel env workers, multi-host tests) must not interleave output
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+        if proc.returncode != 0:
+            return False
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.ddls_lookahead.restype = None
+    lib.ddls_lookahead.argtypes = [
+        ctypes.c_int64, _f64, _i32, _f64, _i32,        # ops
+        ctypes.c_int64, _f64, _i32, _i32, _u8, _u8, _f64,  # deps
+        ctypes.c_int64, _i32,                          # links, dep_channel
+        ctypes.c_int64, ctypes.c_int64,                # workers, channels
+        _f64,                                          # out[5]
+    ]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The compiled kernel library, or None when unavailable."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if _compile():
+                _lib = _bind(ctypes.CDLL(_LIB))
+            else:
+                _load_failed = True
+        except OSError:
+            _load_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def run_lookahead(arrays) -> Optional[Tuple[float, float, float, float]]:
+    """Run the C++ lookahead on a ``LookaheadArrays`` built with
+    ``dtype=np.float64`` and exact (unpadded) sizes. Returns
+    (t, comm_overhead, comp_overhead, busy) for ONE training step, or
+    None when the library is unavailable or the engine could not finish
+    (caller falls back to the host engine, which raises with
+    diagnostics)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    a = arrays
+    out = np.zeros(5, dtype=np.float64)
+    lib.ddls_lookahead(
+        a.op_remaining.shape[0],
+        np.ascontiguousarray(a.op_remaining, np.float64),
+        np.ascontiguousarray(a.op_worker, np.int32),
+        np.ascontiguousarray(a.op_score, np.float64),
+        np.ascontiguousarray(a.num_parents, np.int32),
+        a.dep_remaining.shape[0],
+        np.ascontiguousarray(a.dep_remaining, np.float64),
+        np.ascontiguousarray(a.dep_src, np.int32),
+        np.ascontiguousarray(a.dep_dst, np.int32),
+        np.ascontiguousarray(a.dep_mutual, np.uint8),
+        np.ascontiguousarray(a.dep_is_flow, np.uint8),
+        np.ascontiguousarray(a.dep_score, np.float64),
+        a.dep_channel.shape[1],
+        np.ascontiguousarray(a.dep_channel, np.int32),
+        a.num_workers, a.num_channels, out)
+    if out[4] != 1.0:
+        return None
+    return float(out[0]), float(out[1]), float(out[2]), float(out[3])

@@ -143,16 +143,28 @@ class OpSchedule:
 
 
 class DepPlacement:
-    """job -> dep -> set(channel ids); None channel means not a flow
-    (reference: actions/dep_placement.py:6)."""
+    """job -> dep -> channel-id tuple (or any iterable); a None entry means
+    not a flow (reference: actions/dep_placement.py:6).
 
-    def __init__(self, action: Dict[int, Dict[EdgeId, Set[Optional[str]]]]):
+    The placer hands many deps the *same* channel tuple (all deps of one
+    server pair ride the same channels), so the real-channel views are
+    deduplicated per distinct tuple and shared — they are read-only
+    downstream."""
+
+    def __init__(self, action: Dict[int, Dict[EdgeId, tuple]]):
         self.action = action
         self.job_ids: Set[int] = set(self.action)
-        self.jobdep_to_channels: Dict[Tuple[int, EdgeId], Set[str]] = {}
+        self.jobdep_to_channels: Dict[Tuple[int, EdgeId],
+                                      frozenset] = {}
+        views: Dict[int, frozenset] = {}
         for job_id, dep_to_channels in self.action.items():
             for dep_id, channels in dep_to_channels.items():
-                real = {c for c in channels if c is not None}
+                key = id(channels)
+                real = views.get(key)
+                if real is None:
+                    real = frozenset(
+                        c for c in channels if c is not None)
+                    views[key] = real
                 self.jobdep_to_channels[(job_id, dep_id)] = real
 
 

@@ -63,11 +63,18 @@ class RampClusterEnvironment:
                  use_sqlite_database: bool = False,
                  suppress_warnings: bool = True,
                  use_jax_lookahead: bool = False,
+                 use_native_lookahead: str | bool = "auto",
                  machine_epsilon: float = 1e-7):
         self.name = name
         self.use_sqlite_database = use_sqlite_database
         # opt-in array-engine lookahead backend (docs/jax_lookahead_gonogo.md)
         self.use_jax_lookahead = use_jax_lookahead
+        # C++ lookahead engine (ddls_tpu/native): bit-exact with the host
+        # engine, so "auto" enables it whenever the library builds/loads
+        if use_native_lookahead == "auto":
+            from ddls_tpu.native import native_available
+            use_native_lookahead = native_available()
+        self.use_native_lookahead = bool(use_native_lookahead)
         self.machine_epsilon = machine_epsilon
         self.suppress_warnings = suppress_warnings
         self.save_freq = save_freq
@@ -119,7 +126,9 @@ class RampClusterEnvironment:
         self.jobs_completed: Dict[int, Job] = {}
         self.jobs_blocked: Dict[int, Job] = {}
         self.job_op_to_worker: Dict[Tuple[int, str], str] = {}
-        self.job_dep_to_channels: Dict[Tuple[int, EdgeId], Set[str]] = defaultdict(set)
+        # values are shared frozensets (one per distinct channel tuple of a
+        # dep placement) assigned wholesale in _place_deps — never mutated
+        self.job_dep_to_channels: Dict[Tuple[int, EdgeId], frozenset] = {}
         self.job_id_to_job_idx: Dict[int, int] = {}
         self.job_idx_to_job_id: Dict[int, int] = {}
         self.job_op_placement: Dict[int, Dict[str, str]] = {}
@@ -360,8 +369,14 @@ class RampClusterEnvironment:
         for op in job.graph.op_ids:
             w = self.job_op_to_worker[(job_idx, op)]
             groups.append(worker_to_group.setdefault(w, len(worker_to_group)))
-        dep_times = tuple(job.dep_init_run_time.get(e, 0.0)
-                          for e in job.graph.edge_ids)
+        # the placed per-dep times as raw bytes: equivalent to (and ~100x
+        # cheaper than) a tuple of the same floats in edge order
+        arr = getattr(job, "dep_init_run_time_arr", None)
+        if arr is not None:
+            dep_times = arr.tobytes()
+        else:
+            dep_times = tuple(job.dep_init_run_time.get(e, 0.0)
+                              for e in job.graph.edge_ids)
         return (job.details["model"], split, tuple(groups), dep_times)
 
     def _perform_lookahead_job_completion_time(self, action) -> None:
@@ -371,14 +386,35 @@ class RampClusterEnvironment:
             key = self._lookahead_cache_key(job, job_id)
             cached = self.lookahead_cache.get(key)
             if cached is None:
+                # explicit jax opt-in outranks the auto-enabled native
+                # engine; host engine is the always-correct fallback
                 if self.use_jax_lookahead:
                     cached = self._run_jax_lookahead(job)
+                if cached is None and self.use_native_lookahead:
+                    cached = self._run_native_lookahead(job)
                 if cached is None:  # disabled, or padding/shape fallback
                     cached = self._run_lookahead(job)
                 self.lookahead_cache[key] = cached
             jct, comm_oh, comp_oh, busy = cached
             self._register_completed_lookahead(job, jct, comm_oh, comp_oh,
                                                busy)
+
+    def _run_native_lookahead(self, job: Job):
+        """Cache-miss lookahead on the C++ engine (ddls_tpu/native):
+        identical semantics AND identical f64 arithmetic order to
+        ``_run_lookahead``, so results are bit-exact with the host engine.
+        Returns None when the library is unavailable or the engine bails
+        (caller falls through to jax/host paths)."""
+        from ddls_tpu.native import run_lookahead
+        from ddls_tpu.sim.jax_lookahead import build_native_lookahead_arrays
+
+        arrays = build_native_lookahead_arrays(cluster=self, job=job)
+        result = run_lookahead(arrays)
+        if result is None:
+            return None
+        t, comm, comp, busy = result
+        steps = job.num_training_steps
+        return t * steps, comm * steps, comp * steps, busy
 
     def _run_jax_lookahead(self, job: Job):
         """Cache-miss lookahead on the jitted array engine (opt-in;
@@ -559,6 +595,16 @@ class RampClusterEnvironment:
         self.job_queue.remove(job)
         # zero out non-flow dep run times now that placement is known
         job_idx = job.details["job_idx"]
+        arrays = job.graph.finalize()
+        if getattr(job, "dep_init_run_time_arr", None) is not None:
+            worker_to_server = self.topology.worker_to_server
+            job_op_to_worker = self.job_op_to_worker
+            _, is_flow = job.graph.flow_mask(
+                [worker_to_server[job_op_to_worker[(job_idx, op_id)]]
+                 for op_id in arrays["op_ids"]])
+            job.set_dep_init_run_times_bulk(
+                np.where(is_flow, job.dep_init_run_time_arr, 0.0))
+            return
         for u, v in job.graph.edge_ids:
             if job.graph.edge_size(u, v) == 0:
                 job.set_dep_init_run_time((u, v), 0.0)
@@ -581,24 +627,37 @@ class RampClusterEnvironment:
                     worker.op_priority[(job_idx, op_id)] = pri
 
     def _place_deps(self, dep_placement) -> None:
+        channel_lookup = self.topology.channel_id_to_channel
+        jobdep_views = dep_placement.jobdep_to_channels
         for job_id, dep_to_channels in dep_placement.action.items():
             job_idx = self.job_id_to_job_idx[job_id]
             job = self.jobs_running[job_idx]
-            for dep_id, channels in dep_to_channels.items():
-                for ch_id in channels:
-                    if ch_id is None:
-                        continue
-                    channel = self.topology.channel_id_to_channel[ch_id]
-                    # RAMP rule 2: at most one job per channel
-                    if any(idx != job_idx
-                           for idx in channel.mounted_job_idx_to_deps):
-                        raise RuntimeError(
-                            f"RAMP rule violation: channel {ch_id} already "
-                            f"holds job idx(s) "
-                            f"{set(channel.mounted_job_idx_to_deps) - {job_idx}}")
-                    channel.mount(job, dep_id)
-                    job.details["mounted_channels"].add(ch_id)
-                    self.job_dep_to_channels[(job_idx, dep_id)].add(ch_id)
+            # one pass grouping deps per channel, then bulk channel mounts:
+            # same outcome as per-dep Channel.mount at a fraction of the cost
+            ch_to_deps: Dict[str, list] = {}
+            for dep_id in dep_to_channels:
+                real = jobdep_views[(job_id, dep_id)]
+                if not real:
+                    continue
+                self.job_dep_to_channels[(job_idx, dep_id)] = real
+                for ch_id in real:
+                    lst = ch_to_deps.get(ch_id)
+                    if lst is None:
+                        lst = ch_to_deps.setdefault(ch_id, [])
+                    lst.append(dep_id)
+            mounted_channels = job.details["mounted_channels"]
+            for ch_id, deps in ch_to_deps.items():
+                channel = channel_lookup[ch_id]
+                # RAMP rule 2: at most one job per channel
+                if any(idx != job_idx
+                       for idx in channel.mounted_job_idx_to_deps):
+                    raise RuntimeError(
+                        f"RAMP rule violation: channel {ch_id} already "
+                        f"holds job idx(s) "
+                        f"{set(channel.mounted_job_idx_to_deps) - {job_idx}}")
+                channel.mounted_job_idx_to_deps.setdefault(
+                    job_idx, set()).update(deps)
+                mounted_channels.add(ch_id)
             self.job_dep_placement[job_id] = dep_to_channels
 
     def _schedule_deps(self, dep_schedule) -> None:

@@ -66,9 +66,12 @@ class LookaheadArrays:
 
 
 def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
-                           pad_links: int = 1) -> LookaheadArrays:
+                           pad_links: int = 1,
+                           dtype=np.float32) -> LookaheadArrays:
     """Assemble padded arrays for a job already mounted on the cluster
-    (the same inputs the host engine reads)."""
+    (the same inputs the host engine reads). ``dtype`` sets the float
+    width: f32 for the jitted engine, f64 for the native (C++) engine whose
+    contract is bit-exact parity with the host engine."""
     job_idx = job.details["job_idx"]
     graph = job.graph
     arrays = graph.finalize()
@@ -82,12 +85,12 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
                          for op in graph.op_ids})
     worker_dense = {w: i for i, w in enumerate(worker_ids)}
 
-    op_remaining = np.zeros(pad_ops, np.float32)
+    op_remaining = np.zeros(pad_ops, dtype)
     op_remaining[:n] = arrays["compute"]
     op_valid = np.zeros(pad_ops, bool)
     op_valid[:n] = True
     op_worker = np.full(pad_ops, -1, np.int32)
-    op_score = np.zeros(pad_ops, np.float32)
+    op_score = np.zeros(pad_ops, dtype)
     num_parents = np.zeros(pad_ops, np.int32)
     num_parents[:n] = arrays["num_parents"]
 
@@ -100,7 +103,7 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
         pri = topo.workers[w].op_priority.get((job_idx, op_id), 0)
         op_score[i] = pri * (n + 1) + (n - sorted_rank[op_id])
 
-    dep_remaining = np.zeros(pad_deps, np.float32)
+    dep_remaining = np.zeros(pad_deps, dtype)
     dep_valid = np.zeros(pad_deps, bool)
     dep_valid[:m] = True
     dep_src = np.zeros(pad_deps, np.int32)
@@ -108,7 +111,7 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
     dep_mutual = np.zeros(pad_deps, bool)
     dep_mutual[:m] = arrays["edge_mutual"]
     dep_is_flow = np.zeros(pad_deps, bool)
-    dep_score = np.zeros(pad_deps, np.float32)
+    dep_score = np.zeros(pad_deps, dtype)
     dep_channel = np.full((pad_deps, pad_links), -1, np.int32)
 
     # dense per-job channel renumbering
@@ -149,6 +152,98 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
         op_score=op_score, num_parents=num_parents,
         dep_remaining=dep_remaining, dep_valid=dep_valid, dep_src=dep_src,
         dep_dst=dep_dst, dep_mutual=dep_mutual, dep_is_flow=dep_is_flow,
+        dep_score=dep_score, dep_channel=dep_channel,
+        num_workers=max(len(worker_dense), 1),
+        num_channels=max(len(chan_dense), 1))
+
+
+def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
+    """Exact-size f64 packing for the C++ engine (ddls_tpu/native).
+
+    Produces the same arrays as :func:`build_lookahead_arrays` (same score
+    formulas, so results are identical), but vectorised: the only Python
+    loops left are one O(n_ops) pass for worker/priority lookups and one
+    pass over *flow* deps for channel lists — the O(n_deps) per-edge dict
+    walk is replaced by index arithmetic on ``graph.finalize()`` arrays.
+    """
+    job_idx = job.details["job_idx"]
+    graph = job.graph
+    arrays = graph.finalize()
+    n, m = graph.n_ops, graph.n_deps
+    topo = cluster.topology
+    op_ids = arrays["op_ids"]
+    job_op_to_worker = cluster.job_op_to_worker
+    worker_to_server = topo.worker_to_server
+    workers = topo.workers
+
+    op_worker = np.empty(n, np.int32)
+    op_pri = np.zeros(n, np.float64)
+    server_of_op = []
+    worker_dense: Dict[str, int] = {}
+    for i, op_id in enumerate(op_ids):
+        w = job_op_to_worker[(job_idx, op_id)]
+        wi = worker_dense.get(w)
+        if wi is None:
+            wi = worker_dense.setdefault(w, len(worker_dense))
+        op_worker[i] = wi
+        server_of_op.append(worker_to_server[w])
+        pri = workers[w].op_priority.get((job_idx, op_id), 0)
+        if pri:
+            op_pri[i] = pri
+
+    op_score = op_pri * (n + 1) + (n - arrays["op_sorted_rank"])
+
+    edge_src = arrays["edge_src"].astype(np.int32)
+    edge_dst = arrays["edge_dst"].astype(np.int32)
+    _, dep_is_flow = graph.flow_mask(server_of_op)
+
+    if getattr(job, "dep_init_run_time_arr", None) is not None:
+        dep_remaining = job.dep_init_run_time_arr
+    else:
+        dep_remaining = np.zeros(m, np.float64)
+        edge_index = arrays["edge_index"]
+        for edge, t in job.dep_init_run_time.items():
+            dep_remaining[edge_index[edge]] = t
+
+    # channels + priorities: flow deps only
+    dep_pri = np.zeros(m, np.float64)
+    edge_ids = arrays["edge_ids"]
+    chan_dense: Dict[str, int] = {}
+    job_dep_to_channels = cluster.job_dep_to_channels
+    channel_id_to_channel = topo.channel_id_to_channel
+    flow_idx = np.nonzero(dep_is_flow)[0]
+    flow_channels = []
+    links = 1
+    for ei in flow_idx:
+        edge = edge_ids[ei]
+        channels = sorted(job_dep_to_channels.get((job_idx, edge), ()))
+        dense = []
+        for ch_id in channels:
+            ci = chan_dense.get(ch_id)
+            if ci is None:
+                ci = chan_dense.setdefault(ch_id, len(chan_dense))
+            dense.append(ci)
+        flow_channels.append(dense)
+        if len(dense) > links:
+            links = len(dense)
+        if channels:
+            pri = channel_id_to_channel[channels[0]].dep_priority.get(
+                (job_idx, edge), 0)
+            if pri:
+                dep_pri[ei] = pri
+
+    dep_score = dep_pri * (m + 1) + (m - arrays["edge_sorted_rank"])
+    dep_channel = np.full((m, links), -1, np.int32)
+    for ei, dense in zip(flow_idx, flow_channels):
+        dep_channel[ei, :len(dense)] = dense
+
+    return LookaheadArrays(
+        op_remaining=arrays["compute"], op_valid=np.ones(n, bool),
+        op_worker=op_worker, op_score=op_score,
+        num_parents=arrays["num_parents"].astype(np.int32),
+        dep_remaining=dep_remaining, dep_valid=np.ones(m, bool),
+        dep_src=edge_src, dep_dst=edge_dst,
+        dep_mutual=arrays["edge_mutual"], dep_is_flow=dep_is_flow,
         dep_score=dep_score, dep_channel=dep_channel,
         num_workers=max(len(worker_dense), 1),
         num_channels=max(len(chan_dense), 1))

@@ -8,7 +8,10 @@ from ddls_tpu.envs.partitioning_env import RampJobPartitioningEnvironment
 
 
 def _make_env(dataset_dir, max_partitions=4):
+    # the C++ engine (auto-enabled) would absorb every cache-miss lookahead
+    # before the host/jax engines under test here ever ran
     return RampJobPartitioningEnvironment(
+        use_native_lookahead=False,
         topology_config={"type": "ramp", "kwargs": {
             "num_communication_groups": 2,
             "num_racks_per_communication_group": 2,
