@@ -89,7 +89,10 @@ def make_env_kwargs(dataset_dir: str) -> dict:
         reward_function="job_acceptance",
         reward_function_kwargs={"fail_reward": -1, "success_reward": 1},
         max_simulation_run_time=1e6,
-        pad_obs_kwargs={"max_nodes": 150})
+        # max_edges mirrors env_dev.yaml: without it the obs pads edges to
+        # the fully-connected bound (11,175 for 150 nodes), dragging ~20x
+        # dead padding through every GNN forward and update
+        pad_obs_kwargs={"max_nodes": 150, "max_edges": 512})
 
 
 def make_env_fn(dataset_dir: str):

@@ -16,8 +16,9 @@ job; one unroutable flow drops the whole job
 from __future__ import annotations
 
 import random
-from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
+
+import numpy as np
 
 from ddls_tpu.agents.block_search import (Coord, find_sub_block,
                                           snapshot_free_servers)
@@ -229,8 +230,6 @@ class FirstFitDepPlacer:
         pass
 
     def get(self, op_partition, op_placement, cluster, verbose: bool = False):
-        import numpy as np
-
         from ddls_tpu.sim.actions import DepPlacement
 
         topo = cluster.topology

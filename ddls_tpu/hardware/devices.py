@@ -28,7 +28,9 @@ class Processor:
         self.memory_occupied = 0.0
         self.mounted_job_idx_to_ops: Dict[int, Set[str]] = {}
         self.mounted_job_id: Dict[int, int] = {}
-        self.op_priority: Dict[Tuple[int, str], int] = {}  # (job_idx, op_id) -> priority
+        # job_idx -> {op_id -> priority}: nested so a whole job's
+        # priorities drop in O(1) at unmount and bulk-assign at schedule
+        self.op_priority: Dict[int, Dict[str, int]] = {}
 
     def mount(self, job, op_id: str) -> None:
         mem = job.graph.memory_cost(op_id)
@@ -54,10 +56,13 @@ class Processor:
                 f"{job.job_id} is not mounted")
         self.memory_occupied -= job.graph.memory_cost(op_id)
         self.mounted_job_idx_to_ops[job_idx].discard(op_id)
-        self.op_priority.pop((job_idx, op_id), None)
+        pri = self.op_priority.get(job_idx)
+        if pri is not None:
+            pri.pop(op_id, None)
         if not self.mounted_job_idx_to_ops[job_idx]:
             del self.mounted_job_idx_to_ops[job_idx]
             del self.mounted_job_id[job_idx]
+            self.op_priority.pop(job_idx, None)
 
     @property
     def memory_free(self) -> float:
@@ -126,18 +131,17 @@ class Channel:
 
     def reset(self) -> None:
         self.mounted_job_idx_to_deps: Dict[int, Set[tuple]] = {}
-        self.dep_priority: Dict[Tuple[int, tuple], int] = {}
-
-    def mount(self, job, dep_id: tuple) -> None:
-        job_idx = job.details["job_idx"]
-        self.mounted_job_idx_to_deps.setdefault(job_idx, set()).add(dep_id)
+        self.dep_priority: Dict[int, Dict[tuple, int]] = {}  # job_idx -> {dep -> pri}
 
     def unmount(self, job, dep_id: tuple) -> None:
         job_idx = job.details["job_idx"]
         self.mounted_job_idx_to_deps[job_idx].discard(dep_id)
-        self.dep_priority.pop((job_idx, dep_id), None)
+        pri = self.dep_priority.get(job_idx)
+        if pri is not None:
+            pri.pop(dep_id, None)
         if not self.mounted_job_idx_to_deps[job_idx]:
             del self.mounted_job_idx_to_deps[job_idx]
+            self.dep_priority.pop(job_idx, None)
 
     def __repr__(self) -> str:
         return f"Channel({self.channel_id})"

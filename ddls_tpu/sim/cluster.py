@@ -125,14 +125,18 @@ class RampClusterEnvironment:
         self.jobs_running: Dict[int, Job] = {}
         self.jobs_completed: Dict[int, Job] = {}
         self.jobs_blocked: Dict[int, Job] = {}
-        self.job_op_to_worker: Dict[Tuple[int, str], str] = {}
+        # job_idx -> {op_id -> worker_id}: nested per job so placement
+        # lookups avoid tuple-key hashing and removal drops one entry
+        self.job_op_to_worker: Dict[int, Dict[str, str]] = {}
         # values are shared frozensets (one per distinct channel tuple of a
         # dep placement) assigned wholesale in _place_deps — never mutated
-        self.job_dep_to_channels: Dict[Tuple[int, EdgeId], frozenset] = {}
+        self.job_dep_to_channels: Dict[int, Dict[EdgeId, frozenset]] = {}
         self.job_id_to_job_idx: Dict[int, int] = {}
         self.job_idx_to_job_id: Dict[int, int] = {}
         self.job_op_placement: Dict[int, Dict[str, str]] = {}
-        self.job_dep_placement: Dict[int, Dict[EdgeId, Set[Optional[str]]]] = {}
+        # values are DepPlacement.action entries: dep -> channel-id tuple
+        # (shared per server pair; (None,) for non-flows)
+        self.job_dep_placement: Dict[int, Dict[EdgeId, tuple]] = {}
         self.step_counter = 0
         self.action = None
         self.op_partition = None
@@ -223,23 +227,28 @@ class RampClusterEnvironment:
         # precompute static per-tick structures (flow-ness, sorted op lists
         # per worker with op indices, per-channel sorted dep indices) --
         # these never change during the lookahead
+        op_to_worker = self.job_op_to_worker[job_idx]
         is_flow = np.zeros(graph.n_deps, dtype=bool)
         for ei, (u, v) in enumerate(state.edge_ids):
             if graph.edge_size(u, v) == 0:
                 continue
-            src_w = self.job_op_to_worker[(job_idx, u)]
-            dst_w = self.job_op_to_worker[(job_idx, v)]
+            src_w = op_to_worker[u]
+            dst_w = op_to_worker[v]
             is_flow[ei] = (self.topology.worker_to_server[src_w]
                            != self.topology.worker_to_server[dst_w])
-        worker_op_lists = [
-            [(state.op_index[op_id], w.op_priority.get((job_idx, op_id), 0))
-             for op_id in sorted(w.mounted_job_idx_to_ops[job_idx])]
-            for w in workers_with_job]
-        channel_dep_lists = [
-            (ch.channel_id,
-             [(state.edge_index[dep], ch.dep_priority.get((job_idx, dep), 0))
-              for dep in sorted(ch.mounted_job_idx_to_deps[job_idx])])
-            for ch in channels_with_job]
+        worker_op_lists = []
+        for w in workers_with_job:
+            pri_map = w.op_priority.get(job_idx, {})
+            worker_op_lists.append(
+                [(state.op_index[op_id], pri_map.get(op_id, 0))
+                 for op_id in sorted(w.mounted_job_idx_to_ops[job_idx])])
+        channel_dep_lists = []
+        for ch in channels_with_job:
+            pri_map = ch.dep_priority.get(job_idx, {})
+            channel_dep_lists.append(
+                (ch.channel_id,
+                 [(state.edge_index[dep], pri_map.get(dep, 0))
+                  for dep in sorted(ch.mounted_job_idx_to_deps[job_idx])]))
 
         t = comm_oh = comp_oh = busy = 0.0
         guard = 0
@@ -366,8 +375,9 @@ class RampClusterEnvironment:
             self.op_partition.job_id_to_split_forward_ops[job_id].items()))
         worker_to_group: Dict[str, int] = {}
         groups = []
+        op_to_worker = self.job_op_to_worker[job_idx]
         for op in job.graph.op_ids:
-            w = self.job_op_to_worker[(job_idx, op)]
+            w = op_to_worker[op]
             groups.append(worker_to_group.setdefault(w, len(worker_to_group)))
         # the placed per-dep times as raw bytes: equivalent to (and ~100x
         # cheaper than) a tuple of the same floats in edge order
@@ -585,7 +595,8 @@ class RampClusterEnvironment:
                         f"cannot mount job idx {job_idx}")
                 worker.mount(job, op_id)
                 job.details["mounted_workers"].add(worker_id)
-                self.job_op_to_worker[(job_idx, op_id)] = worker_id
+                self.job_op_to_worker.setdefault(job_idx, {})[op_id] = \
+                    worker_id
             self._register_running_job(job)
             self.job_op_placement[job_id] = dict(op_to_worker)
 
@@ -598,9 +609,9 @@ class RampClusterEnvironment:
         arrays = job.graph.finalize()
         if getattr(job, "dep_init_run_time_arr", None) is not None:
             worker_to_server = self.topology.worker_to_server
-            job_op_to_worker = self.job_op_to_worker
+            op_to_worker = self.job_op_to_worker[job_idx]
             _, is_flow = job.graph.flow_mask(
-                [worker_to_server[job_op_to_worker[(job_idx, op_id)]]
+                [worker_to_server[op_to_worker[op_id]]
                  for op_id in arrays["op_ids"]])
             job.set_dep_init_run_times_bulk(
                 np.where(is_flow, job.dep_init_run_time_arr, 0.0))
@@ -609,8 +620,8 @@ class RampClusterEnvironment:
             if job.graph.edge_size(u, v) == 0:
                 job.set_dep_init_run_time((u, v), 0.0)
             else:
-                src_w = self.job_op_to_worker[(job_idx, u)]
-                dst_w = self.job_op_to_worker[(job_idx, v)]
+                src_w = self.job_op_to_worker[job_idx][u]
+                dst_w = self.job_op_to_worker[job_idx][v]
                 if (self.topology.worker_to_server[src_w]
                         == self.topology.worker_to_server[dst_w]):
                     job.set_dep_init_run_time((u, v), 0.0)
@@ -623,8 +634,7 @@ class RampClusterEnvironment:
             worker = self.topology.workers[worker_id]
             for job_id, op_to_pri in job_to_ops.items():
                 job_idx = self.job_id_to_job_idx[job_id]
-                for op_id, pri in op_to_pri.items():
-                    worker.op_priority[(job_idx, op_id)] = pri
+                worker.op_priority.setdefault(job_idx, {}).update(op_to_pri)
 
     def _place_deps(self, dep_placement) -> None:
         channel_lookup = self.topology.channel_id_to_channel
@@ -639,7 +649,8 @@ class RampClusterEnvironment:
                 real = jobdep_views[(job_id, dep_id)]
                 if not real:
                     continue
-                self.job_dep_to_channels[(job_idx, dep_id)] = real
+                self.job_dep_to_channels.setdefault(
+                    job_idx, {})[dep_id] = real
                 for ch_id in real:
                     lst = ch_to_deps.get(ch_id)
                     if lst is None:
@@ -667,8 +678,8 @@ class RampClusterEnvironment:
             channel = self.topology.channel_id_to_channel[ch_id]
             for job_id, dep_to_pri in job_to_deps.items():
                 job_idx = self.job_id_to_job_idx[job_id]
-                for dep_id, pri in dep_to_pri.items():
-                    channel.dep_priority[(job_idx, dep_id)] = pri
+                channel.dep_priority.setdefault(job_idx, {}).update(
+                    dep_to_pri)
 
     # -------------------------------------------------------------- lifecycle
     def _remove_job_from_cluster(self, job: Job) -> None:
@@ -676,18 +687,17 @@ class RampClusterEnvironment:
         if job.job_id in self.job_queue.jobs:
             self.job_queue.remove(job)
         self.jobs_running.pop(job_idx, None)
-        for op_id in job.graph.op_ids:
-            key = (job_idx, op_id)
-            worker_id = self.job_op_to_worker.pop(key, None)
-            if worker_id is not None:
-                self.topology.workers[worker_id].unmount(job, op_id)
-        for dep_id in job.graph.edge_ids:
-            key = (job_idx, dep_id)
-            if key in self.job_dep_to_channels:
-                for ch_id in self.job_dep_to_channels[key]:
-                    self.topology.channel_id_to_channel[ch_id].unmount(
-                        job, dep_id)
-                del self.job_dep_to_channels[key]
+        op_to_worker = self.job_op_to_worker.pop(job_idx, None)
+        if op_to_worker:
+            workers = self.topology.workers
+            for op_id, worker_id in op_to_worker.items():
+                workers[worker_id].unmount(job, op_id)
+        dep_map = self.job_dep_to_channels.pop(job_idx, None)
+        if dep_map:
+            channel_lookup = self.topology.channel_id_to_channel
+            for dep_id, channels in dep_map.items():
+                for ch_id in channels:
+                    channel_lookup[ch_id].unmount(job, dep_id)
         self.job_op_placement.pop(job.job_id, None)
         self.job_dep_placement.pop(job.job_id, None)
 

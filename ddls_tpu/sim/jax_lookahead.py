@@ -66,12 +66,11 @@ class LookaheadArrays:
 
 
 def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
-                           pad_links: int = 1,
-                           dtype=np.float32) -> LookaheadArrays:
+                           pad_links: int = 1) -> LookaheadArrays:
     """Assemble padded arrays for a job already mounted on the cluster
-    (the same inputs the host engine reads). ``dtype`` sets the float
-    width: f32 for the jitted engine, f64 for the native (C++) engine whose
-    contract is bit-exact parity with the host engine."""
+    (the same inputs the host engine reads). f32: feeds the jitted engine
+    (the C++ engine has its own exact-size f64 packer,
+    :func:`build_native_lookahead_arrays`)."""
     job_idx = job.details["job_idx"]
     graph = job.graph
     arrays = graph.finalize()
@@ -80,17 +79,17 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
         raise ValueError(f"job needs ({n},{m}) > padding ({pad_ops},{pad_deps})")
 
     topo = cluster.topology
+    op_to_worker = cluster.job_op_to_worker[job_idx]
     # dense per-job worker renumbering (only workers holding this job matter)
-    worker_ids = sorted({cluster.job_op_to_worker[(job_idx, op)]
-                         for op in graph.op_ids})
+    worker_ids = sorted({op_to_worker[op] for op in graph.op_ids})
     worker_dense = {w: i for i, w in enumerate(worker_ids)}
 
-    op_remaining = np.zeros(pad_ops, dtype)
+    op_remaining = np.zeros(pad_ops, np.float32)
     op_remaining[:n] = arrays["compute"]
     op_valid = np.zeros(pad_ops, bool)
     op_valid[:n] = True
     op_worker = np.full(pad_ops, -1, np.int32)
-    op_score = np.zeros(pad_ops, dtype)
+    op_score = np.zeros(pad_ops, np.float32)
     num_parents = np.zeros(pad_ops, np.int32)
     num_parents[:n] = arrays["num_parents"]
 
@@ -98,12 +97,12 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
     sorted_rank = {op: r for r, op in enumerate(sorted(graph.op_ids))}
     for op_id in graph.op_ids:
         i = arrays["op_index"][op_id]
-        w = cluster.job_op_to_worker[(job_idx, op_id)]
+        w = op_to_worker[op_id]
         op_worker[i] = worker_dense[w]
-        pri = topo.workers[w].op_priority.get((job_idx, op_id), 0)
+        pri = topo.workers[w].op_priority.get(job_idx, {}).get(op_id, 0)
         op_score[i] = pri * (n + 1) + (n - sorted_rank[op_id])
 
-    dep_remaining = np.zeros(pad_deps, dtype)
+    dep_remaining = np.zeros(pad_deps, np.float32)
     dep_valid = np.zeros(pad_deps, bool)
     dep_valid[:m] = True
     dep_src = np.zeros(pad_deps, np.int32)
@@ -111,7 +110,7 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
     dep_mutual = np.zeros(pad_deps, bool)
     dep_mutual[:m] = arrays["edge_mutual"]
     dep_is_flow = np.zeros(pad_deps, bool)
-    dep_score = np.zeros(pad_deps, dtype)
+    dep_score = np.zeros(pad_deps, np.float32)
     dep_channel = np.full((pad_deps, pad_links), -1, np.int32)
 
     # dense per-job channel renumbering
@@ -124,14 +123,14 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
         dep_src[ei] = arrays["op_index"][u]
         dep_dst[ei] = arrays["op_index"][v]
         dep_remaining[ei] = job.dep_init_run_time.get(edge, 0.0)
-        src_w = cluster.job_op_to_worker[(job_idx, u)]
-        dst_w = cluster.job_op_to_worker[(job_idx, v)]
+        src_w = op_to_worker[u]
+        dst_w = op_to_worker[v]
         is_flow = (graph.edge_size(u, v) > 0
                    and worker_to_server[src_w] != worker_to_server[dst_w])
         dep_is_flow[ei] = is_flow
         if is_flow:
             channels = sorted(cluster.job_dep_to_channels.get(
-                (job_idx, edge), ()))
+                job_idx, {}).get(edge, ()))
             if len(channels) > pad_links:
                 raise ValueError(
                     f"dep {edge} rides {len(channels)} channels > pad_links "
@@ -141,7 +140,7 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
                     ch_id, len(chan_dense))
             ch = (topo.channel_id_to_channel[channels[0]]
                   if channels else None)
-            pri = (ch.dep_priority.get((job_idx, edge), 0)
+            pri = (ch.dep_priority.get(job_idx, {}).get(edge, 0)
                    if ch is not None else 0)
         else:
             pri = 0
@@ -172,7 +171,7 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
     n, m = graph.n_ops, graph.n_deps
     topo = cluster.topology
     op_ids = arrays["op_ids"]
-    job_op_to_worker = cluster.job_op_to_worker
+    op_to_worker = cluster.job_op_to_worker[job_idx]
     worker_to_server = topo.worker_to_server
     workers = topo.workers
 
@@ -180,14 +179,16 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
     op_pri = np.zeros(n, np.float64)
     server_of_op = []
     worker_dense: Dict[str, int] = {}
+    pri_maps: Dict[str, Dict[str, int]] = {}
     for i, op_id in enumerate(op_ids):
-        w = job_op_to_worker[(job_idx, op_id)]
+        w = op_to_worker[op_id]
         wi = worker_dense.get(w)
         if wi is None:
             wi = worker_dense.setdefault(w, len(worker_dense))
+            pri_maps[w] = workers[w].op_priority.get(job_idx, {})
         op_worker[i] = wi
         server_of_op.append(worker_to_server[w])
-        pri = workers[w].op_priority.get((job_idx, op_id), 0)
+        pri = pri_maps[w].get(op_id, 0)
         if pri:
             op_pri[i] = pri
 
@@ -209,14 +210,14 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
     dep_pri = np.zeros(m, np.float64)
     edge_ids = arrays["edge_ids"]
     chan_dense: Dict[str, int] = {}
-    job_dep_to_channels = cluster.job_dep_to_channels
+    dep_to_channels = cluster.job_dep_to_channels.get(job_idx, {})
     channel_id_to_channel = topo.channel_id_to_channel
     flow_idx = np.nonzero(dep_is_flow)[0]
     flow_channels = []
     links = 1
     for ei in flow_idx:
         edge = edge_ids[ei]
-        channels = sorted(job_dep_to_channels.get((job_idx, edge), ()))
+        channels = sorted(dep_to_channels.get(edge, ()))
         dense = []
         for ch_id in channels:
             ci = chan_dense.get(ch_id)
@@ -228,7 +229,7 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
             links = len(dense)
         if channels:
             pri = channel_id_to_channel[channels[0]].dep_priority.get(
-                (job_idx, edge), 0)
+                job_idx, {}).get(edge, 0)
             if pri:
                 dep_pri[ei] = pri
 

@@ -225,8 +225,8 @@ class ClusterEnvironment:
             worker = self.topology.workers[worker_id]
             for job_id, op_to_pri in job_to_ops.items():
                 job_idx = self.job_id_to_job_idx[job_id]
-                for op_id, pri in op_to_pri.items():
-                    worker.op_priority[(job_idx, op_id)] = pri
+                worker.op_priority.setdefault(job_idx, {}).update(
+                    op_to_pri)
 
     def _tick_workers(self, max_tick: float) -> Dict[int, List[int]]:
         """One cluster tick: each worker's highest-priority ready op runs
@@ -240,11 +240,12 @@ class ClusterEnvironment:
                 if job_idx not in self.exec_states:
                     continue  # job still queued (mounted mid-step)
                 state = self.exec_states[job_idx]
+                pri_map = worker.op_priority.get(job_idx, {})
                 for op_id in sorted(worker.mounted_job_idx_to_ops[job_idx]):
                     oi = state.op_index[op_id]
                     if oi not in state.ops_ready:
                         continue
-                    pri = worker.op_priority.get((job_idx, op_id), 0)
+                    pri = pri_map.get(op_id, 0)
                     if best is None or pri > best[0]:
                         best = (pri, job_idx, oi)
             if best is not None:

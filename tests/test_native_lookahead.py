@@ -10,7 +10,6 @@ import pytest
 
 from ddls_tpu.envs import RampJobPartitioningEnvironment
 from ddls_tpu.native import native_available, run_lookahead
-from ddls_tpu.sim.jax_lookahead import build_lookahead_arrays
 
 pytestmark = pytest.mark.skipif(
     not native_available(), reason="native toolchain unavailable")
@@ -110,8 +109,6 @@ def test_full_episode_outcomes_identical(tmp_path):
 def test_native_bails_to_none_on_livelock():
     """A non-flow dep with positive remaining can never finish (the host
     engine raises); the native engine must return None (fall back)."""
-    import dataclasses
-
     from ddls_tpu.sim.jax_lookahead import LookaheadArrays
 
     arrays = LookaheadArrays(
