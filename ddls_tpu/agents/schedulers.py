@@ -12,6 +12,8 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Dict
 
+import numpy as np
+
 
 class SRPTOpScheduler:
     def __init__(self, **kwargs):
@@ -47,26 +49,51 @@ class SRPTDepScheduler:
             return DepSchedule({})
         # global SRPT ordering over all newly placed flow deps, priced by the
         # comm model (reference sorts all jobdeps together,
-        # srpt_dep_scheduler.py:66-77)
-        costed = []
+        # srpt_dep_scheduler.py:66-77). Costs come straight from the priced
+        # array and the descending sort is one stable argsort; tie order
+        # among equal costs only differs from the tuple-sort original for
+        # zero-cost non-flows, whose priorities land on the None channel
+        # that no engine reads.
+        jobs, deps_lists, costs_list = [], [], []
         for job_id, dep_to_channels in dep_placement.action.items():
             job = op_partition.partitioned_jobs[job_id]
-            for dep_id in dep_to_channels:
-                cost = job.dep_init_run_time.get(dep_id, 0.0)
-                costed.append((job_id, dep_id, cost))
-        costed.sort(key=lambda t: t[2], reverse=True)
+            arr = getattr(job, "dep_init_run_time_arr", None)
+            edge_ids = job.graph.edge_ids
+            # FirstFitDepPlacer keys dep_to_channels with entries drawn
+            # from graph.edge_ids (every edge gets a channel tuple or the
+            # _NONFLOW marker), so equal length implies the key sets are
+            # identical and edge order can stand in for action order
+            if arr is not None and len(dep_to_channels) == len(edge_ids):
+                deps, costs = edge_ids, arr
+            else:
+                deps = list(dep_to_channels)
+                costs = np.array(
+                    [job.dep_init_run_time.get(d, 0.0) for d in deps],
+                    np.float64)
+            jobs.append(job_id)
+            deps_lists.append(deps)
+            costs_list.append(costs)
+        all_costs = (np.concatenate(costs_list) if len(costs_list) > 1
+                     else costs_list[0])
+        order = np.argsort(-all_costs, kind="stable")
+        pri = np.empty(len(order), np.int64)
+        pri[order] = np.arange(len(order))
 
         action: Dict[str, Dict[int, Dict[tuple, int]]] = defaultdict(
             lambda: defaultdict(dict))
-        for priority, (job_id, dep_id, _) in enumerate(costed):
-            channels = dep_placement.jobdep_to_channels.get(
-                (job_id, dep_id), set())
-            if not channels:
-                # non-flow dep: keep it under the None channel so the job
-                # still counts as handled by this sub-action (the reference
-                # schedules non-flows onto a None channel key,
-                # srpt_dep_scheduler.py:57-63 + cluster :1404-1415)
-                action[None][job_id][dep_id] = priority
-            for ch_id in channels:
-                action[ch_id][job_id][dep_id] = priority
+        jobdep_to_channels = dep_placement.jobdep_to_channels
+        offset = 0
+        for job_id, deps in zip(jobs, deps_lists):
+            for k, dep_id in enumerate(deps):
+                priority = int(pri[offset + k])
+                channels = jobdep_to_channels.get((job_id, dep_id), ())
+                if not channels:
+                    # non-flow dep: keep it under the None channel so the
+                    # job still counts as handled by this sub-action (the
+                    # reference schedules non-flows onto a None channel key,
+                    # srpt_dep_scheduler.py:57-63 + cluster :1404-1415)
+                    action[None][job_id][dep_id] = priority
+                for ch_id in channels:
+                    action[ch_id][job_id][dep_id] = priority
+            offset += len(deps)
         return DepSchedule({k: dict(v) for k, v in action.items()})

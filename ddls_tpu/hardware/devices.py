@@ -11,7 +11,7 @@ compute costs in job graphs.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Set, Tuple
+from typing import Dict, Optional, Set
 
 
 class Processor:
@@ -63,6 +63,17 @@ class Processor:
             del self.mounted_job_idx_to_ops[job_idx]
             del self.mounted_job_id[job_idx]
             self.op_priority.pop(job_idx, None)
+
+    def unmount_job(self, job) -> None:
+        """Drop every op of one job in one pop per structure (bulk
+        equivalent of per-op :meth:`unmount`)."""
+        job_idx = job.details["job_idx"]
+        ops = self.mounted_job_idx_to_ops.pop(job_idx, None)
+        if ops:
+            memory_cost = job.graph.memory_cost
+            self.memory_occupied -= sum(memory_cost(op) for op in ops)
+        self.op_priority.pop(job_idx, None)
+        self.mounted_job_id.pop(job_idx, None)
 
     @property
     def memory_free(self) -> float:
@@ -133,15 +144,11 @@ class Channel:
         self.mounted_job_idx_to_deps: Dict[int, Set[tuple]] = {}
         self.dep_priority: Dict[int, Dict[tuple, int]] = {}  # job_idx -> {dep -> pri}
 
-    def unmount(self, job, dep_id: tuple) -> None:
-        job_idx = job.details["job_idx"]
-        self.mounted_job_idx_to_deps[job_idx].discard(dep_id)
-        pri = self.dep_priority.get(job_idx)
-        if pri is not None:
-            pri.pop(dep_id, None)
-        if not self.mounted_job_idx_to_deps[job_idx]:
-            del self.mounted_job_idx_to_deps[job_idx]
-            self.dep_priority.pop(job_idx, None)
+    def unmount_job(self, job_idx: int) -> None:
+        """Drop every dep of one job (the only unmount granularity the
+        cluster needs: deps leave a channel when their job does)."""
+        self.mounted_job_idx_to_deps.pop(job_idx, None)
+        self.dep_priority.pop(job_idx, None)
 
     def __repr__(self) -> str:
         return f"Channel({self.channel_id})"

@@ -486,10 +486,15 @@ class RampClusterEnvironment:
         job.details["mean_mounted_worker_utilisation_frac"] = util
 
         # total size of deps that became flows (nonzero placed run time)
-        flow_size = 0.0
-        for edge, run_time in job.dep_init_run_time.items():
-            if run_time != 0:
-                flow_size += job.graph.edge_size(*edge)
+        arr = getattr(job, "dep_init_run_time_arr", None)
+        if arr is not None:
+            flow_size = float(
+                job.graph.finalize()["edge_size"][arr != 0].sum())
+        else:
+            flow_size = 0.0
+            for edge, run_time in job.dep_init_run_time.items():
+                if run_time != 0:
+                    flow_size += job.graph.edge_size(*edge)
         job.details["job_total_flow_size"] = flow_size
 
     # ------------------------------------------------------------------- step
@@ -687,17 +692,16 @@ class RampClusterEnvironment:
         if job.job_id in self.job_queue.jobs:
             self.job_queue.remove(job)
         self.jobs_running.pop(job_idx, None)
-        op_to_worker = self.job_op_to_worker.pop(job_idx, None)
-        if op_to_worker:
+        # bulk unmount: drop the whole job from each device it touched in
+        # one call per device instead of per op / per dep
+        if self.job_op_to_worker.pop(job_idx, None) is not None:
             workers = self.topology.workers
-            for op_id, worker_id in op_to_worker.items():
-                workers[worker_id].unmount(job, op_id)
-        dep_map = self.job_dep_to_channels.pop(job_idx, None)
-        if dep_map:
+            for worker_id in job.details["mounted_workers"]:
+                workers[worker_id].unmount_job(job)
+        if self.job_dep_to_channels.pop(job_idx, None) is not None:
             channel_lookup = self.topology.channel_id_to_channel
-            for dep_id, channels in dep_map.items():
-                for ch_id in channels:
-                    channel_lookup[ch_id].unmount(job, dep_id)
+            for ch_id in job.details["mounted_channels"]:
+                channel_lookup[ch_id].unmount_job(job_idx)
         self.job_op_placement.pop(job.job_id, None)
         self.job_dep_placement.pop(job.job_id, None)
 
