@@ -50,15 +50,18 @@ class SRPTDepScheduler:
         # global SRPT ordering over all newly placed flow deps, priced by the
         # comm model (reference sorts all jobdeps together,
         # srpt_dep_scheduler.py:66-77). Costs come straight from the priced
-        # array and the descending sort is one stable argsort; tie order
-        # among equal costs only differs from the tuple-sort original for
-        # zero-cost non-flows, whose priorities land on the None channel
-        # that no engine reads.
+        # array and the descending sort is one stable argsort. Tie order can
+        # differ from the tuple-sort original only for non-flow deps (the
+        # fast path visits them in edge order rather than placer-insertion
+        # order) — safe because non-flow priorities land exclusively on the
+        # None channel that no engine reads, while flows keep their relative
+        # order in every tie class (per-job edge order, jobs in action
+        # order) in both paths.
         jobs, deps_lists, costs_list = [], [], []
         for job_id, dep_to_channels in dep_placement.action.items():
             job = op_partition.partitioned_jobs[job_id]
             arr = getattr(job, "dep_init_run_time_arr", None)
-            edge_ids = job.graph.edge_ids
+            edge_ids = job.graph.finalize()["edge_ids"]
             # FirstFitDepPlacer keys dep_to_channels with entries drawn
             # from graph.edge_ids (every edge gets a channel tuple or the
             # _NONFLOW marker), so equal length implies the key sets are
