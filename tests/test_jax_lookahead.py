@@ -71,6 +71,10 @@ def _collect_cases(env, actions, n_cases):
             i += 1
             if done or i > 200:
                 obs = env.reset(seed=i)
+                # memo caches persist across resets (same workload); clear
+                # so repeated episodes keep producing cache-miss lookaheads
+                # for the spy to capture
+                cluster.lookahead_cache.clear()
     finally:
         cluster._run_lookahead = orig
     return cases

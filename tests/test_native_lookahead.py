@@ -75,6 +75,9 @@ def test_native_bit_exact_with_host_engine(tmp_path):
         obs, _, done, _ = env.step(int(rng.choice(valid)))
         if done:
             obs = env.reset(seed=100 + i)
+            # caches persist across resets; clear so later episodes keep
+            # producing cache-miss lookaheads for the spy to compare
+            cluster.lookahead_cache.clear()
 
     assert len(compared) >= 5, "episodes produced too few cache-miss lookaheads"
     for host, native, n_ops, n_deps in compared:

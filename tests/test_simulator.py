@@ -241,3 +241,44 @@ def test_ramp_rule_one_job_per_worker(tmp_path):
         w1 = jobs[0].details["mounted_workers"]
         w2 = jobs[1].details["mounted_workers"]
         assert not (w1 & w2)
+
+
+def test_memo_caches_persist_across_resets_same_workload(tmp_path):
+    """Exact-keyed partition/lookahead memos survive reset() while the
+    workload is unchanged (training episodes 2+ reuse them) and are
+    dropped when the dataset or num_training_steps changes (which scales
+    cached lookahead results)."""
+    _chain_profile(tmp_path, n=3)
+    path = str(tmp_path)
+    cluster = _make_cluster()
+    cluster.reset(_jobs_config(path, steps=5), max_simulation_run_time=None,
+                  seed=0)
+    cluster.step(_heuristic_action(cluster, max_parts=2))
+    assert cluster.lookahead_cache, "expected a cached lookahead"
+    cached = dict(cluster.lookahead_cache)
+
+    # same workload: caches persist
+    cluster.reset(_jobs_config(path, steps=5), max_simulation_run_time=None,
+                  seed=1)
+    assert cluster.lookahead_cache == cached
+
+    # changed num_training_steps: caches dropped (values scale by steps)
+    cluster.reset(_jobs_config(path, steps=7), max_simulation_run_time=None,
+                  seed=1)
+    assert not cluster.lookahead_cache
+
+    # and outcomes with a warm cache match a cold-cache run exactly
+    def episode_outcome(cl):
+        cl.step(_heuristic_action(cl, max_parts=2))
+        job = next(iter(cl.jobs_running.values()), None)
+        if job is None:
+            job = next(iter(cl.jobs_completed.values()))
+        return job.details["lookahead_job_completion_time"]
+
+    cluster.reset(_jobs_config(path, steps=5), max_simulation_run_time=None,
+                  seed=2)
+    cold = episode_outcome(cluster)  # steps=5 cache was just dropped
+    cluster.reset(_jobs_config(path, steps=5), max_simulation_run_time=None,
+                  seed=2)
+    warm = episode_outcome(cluster)
+    assert warm == cold
