@@ -202,9 +202,11 @@ class Job:
         self.state: Optional[ExecState] = None
         # per-edge placed communication times, set by the comm model after op
         # placement; survives training-step resets (the reference keeps
-        # these as edge 'init_run_time' attributes, job.py:461-464)
-        self.dep_init_run_time: Dict[EdgeId, float] = {}
-        # aligned-array mirror (graph.edge_ids order); None when stale
+        # these as edge 'init_run_time' attributes, job.py:461-464). The
+        # canonical store on the hot path is the aligned array
+        # (graph.edge_ids order); the dict view is materialised lazily for
+        # the fallback/host-engine readers
+        self._dep_init_run_time: Optional[Dict[EdgeId, float]] = {}
         self.dep_init_run_time_arr = None
         self.training_step_counter = 0
         self.original_job = original_job if original_job is not None else self
@@ -221,6 +223,17 @@ class Job:
         self.state = ExecState(self.graph, self.dep_init_run_time)
         return self.state
 
+    @property
+    def dep_init_run_time(self) -> Dict[EdgeId, float]:
+        """Dict view of the placed per-dep times (lazy: the hot path keeps
+        only the aligned array; fallback readers materialise this once)."""
+        if self._dep_init_run_time is None:
+            arr = self.dep_init_run_time_arr
+            self._dep_init_run_time = (
+                dict(zip(self.graph.edge_ids, arr.tolist()))
+                if arr is not None else {})
+        return self._dep_init_run_time
+
     def set_dep_init_run_time(self, edge: EdgeId, run_time: float) -> None:
         self.dep_init_run_time[edge] = float(run_time)
         self.dep_init_run_time_arr = None  # single-edge write: mirror stale
@@ -230,12 +243,10 @@ class Job:
     def set_dep_init_run_times_bulk(self, times) -> None:
         """Set every dep's initial run time from an array aligned with
         ``graph.edge_ids`` order (the hot path prices all deps at once)."""
-        self.dep_init_run_time = {
-            edge: float(t) for edge, t in zip(self.graph.edge_ids, times)}
-        # aligned-array mirror for the native/array engines' packers
         self.dep_init_run_time_arr = np.asarray(times, np.float64).copy()
+        self._dep_init_run_time = None  # dict view rebuilt on demand
         if self.state is not None:
-            arr = np.asarray(times, dtype=np.float64)
+            arr = self.dep_init_run_time_arr
             self.state.init_dep_run_time[:] = arr
             self.state.remaining_dep[:] = arr
 

@@ -79,6 +79,15 @@ class JobSampler:
         return job
 
 
+def discover_profile_files(path_to_files: str) -> list:
+    """Sorted graph-profile files under a directory — the single discovery
+    rule shared by the generator and by the cluster's workload signature
+    (cache validity must see exactly the files the generator loads)."""
+    return sorted(
+        p for p in glob.glob(path_to_files.rstrip("/") + "/*")
+        if p.endswith(".txt") or p.endswith(".pbtxt"))
+
+
 class JobsGenerator:
     def __init__(self,
                  path_to_files: Optional[str] = None,
@@ -100,6 +109,9 @@ class JobsGenerator:
             raise ValueError(
                 "job_interarrival_time_dist is required (pass a Distribution "
                 "or a {'_target_': ..., **kwargs} dict)")
+        self.num_training_steps = num_training_steps
+        self.device_type = device_type
+        self.max_files = max_files
         generated_paths = None
         if synthetic is not None:
             out_dir = synthetic.get("out_dir") or tempfile.mkdtemp(
@@ -111,9 +123,8 @@ class JobsGenerator:
             path_to_files = out_dir
         self.path_to_files = path_to_files
 
-        file_paths = sorted(generated_paths) if generated_paths is not None else sorted(
-            p for p in glob.glob(path_to_files.rstrip("/") + "/*")
-            if p.endswith(".txt") or p.endswith(".pbtxt"))
+        file_paths = (sorted(generated_paths) if generated_paths is not None
+                      else discover_profile_files(path_to_files))
         if not file_paths:
             raise FileNotFoundError(
                 f"no .txt/.pbtxt graph profiles under {path_to_files}")

@@ -144,13 +144,14 @@ class RampClusterEnvironment:
         self.action = None
         self.op_partition = None
 
-        # memo caches keyed by (model, max partition degree); valid as long as
-        # partition degree fully determines the partitioned graph + schedule
-        # (reference warns about the same constraint, :269-277). They persist
-        # across resets while the workload stays the same — the keys fully
-        # determine the cached outcomes, so training episodes 2+ reuse all
-        # partition/lookahead work — and are dropped when the dataset (or
-        # num_training_steps, which scales cached lookahead results) changes.
+        # memo caches: partition_cache is keyed by (model, full split map)
+        # and lookahead_cache by (model, split map, canonical worker
+        # grouping, priced dep-time bytes) — see _lookahead_cache_key; both
+        # key sets fully determine the cached outcomes, so the caches
+        # persist across resets while the workload stays the same (training
+        # episodes 2+ reuse all partition/lookahead work) and are dropped
+        # when the dataset (or num_training_steps, which scales cached
+        # lookahead results) changes.
         sig = self._workload_signature(jobs_config)
         if sig != getattr(self, "_cache_signature", object()):
             self._cache_signature = sig
@@ -176,27 +177,15 @@ class RampClusterEnvironment:
         Synthetic datasets are deterministic per config (seeded
         generation), so the config content identifies them."""
         if isinstance(jobs_config, JobsGenerator):
-            # reset() pins the generator on self.jobs_generator, so the
-            # object behind this id stays alive while the signature matters
-            return ("generator", id(jobs_config))
+            return ("generator", self._profile_file_stats(
+                        jobs_config.path_to_files),
+                    jobs_config.num_training_steps,
+                    jobs_config.device_type, jobs_config.max_files)
         if isinstance(jobs_config, dict):
             synth = jobs_config.get("synthetic")
-            path = jobs_config.get("path_to_files")
-            # stat the profile files so regenerating different profiles
-            # into the same directory invalidates the caches (the stale
-            # same-path pattern jobs_generator's out_dir comment warns of)
-            files: tuple = ()
-            if path:
-                import glob as _glob
-                import os as _os
-                stats = []
-                for f in sorted(_glob.glob(path.rstrip("/") + "/*")):
-                    if f.endswith(".txt") or f.endswith(".pbtxt"):
-                        st = _os.stat(f)
-                        stats.append((_os.path.basename(f),
-                                      st.st_mtime_ns, st.st_size))
-                files = tuple(stats)
-            return ("dict", path, files,
+            return ("dict",
+                    self._profile_file_stats(
+                        jobs_config.get("path_to_files")),
                     jobs_config.get("num_training_steps", 1),
                     jobs_config.get("device_type", "A100"),
                     jobs_config.get("max_files"),
@@ -205,6 +194,22 @@ class RampClusterEnvironment:
         raise TypeError(
             f"jobs_config must be a JobsGenerator or a mapping, got "
             f"{type(jobs_config).__name__}")
+
+    @staticmethod
+    def _profile_file_stats(path: Optional[str]) -> tuple:
+        """(name, mtime, size) of every profile file the generator would
+        load (same discovery rule), so regenerating different profiles at
+        the same path invalidates the caches."""
+        if not path:
+            return ()
+        import os as _os
+
+        from ddls_tpu.demands.jobs_generator import discover_profile_files
+        stats = []
+        for f in discover_profile_files(path):
+            st = _os.stat(f)
+            stats.append((_os.path.basename(f), st.st_mtime_ns, st.st_size))
+        return (path, tuple(stats))
 
     def _init_step_stats(self) -> dict:
         s = defaultdict(float)
