@@ -19,6 +19,7 @@ Additions over the reference:
 from __future__ import annotations
 
 import glob
+import os
 import random
 import tempfile
 from typing import List, Optional, Union
@@ -125,6 +126,23 @@ class JobsGenerator:
 
         file_paths = (sorted(generated_paths) if generated_paths is not None
                       else discover_profile_files(path_to_files))
+        # workload fingerprint for the cluster's memo-cache validity check:
+        # synthetic datasets are deterministic per config (seeded), so the
+        # config content identifies them regardless of the tmpdir they were
+        # written to; on-disk datasets fingerprint the exact files loaded,
+        # statted at load time (not at reset time — the files could change
+        # on disk after this generator read them)
+        if synthetic is not None:
+            dataset_id = ("synthetic", repr(sorted(synthetic.items())))
+        else:
+            stats = []
+            for f in file_paths:
+                st = os.stat(f)
+                stats.append((os.path.basename(f), st.st_mtime_ns,
+                              st.st_size))
+            dataset_id = ("files", path_to_files, tuple(stats))
+        self.workload_fingerprint = (dataset_id, num_training_steps,
+                                     device_type, max_files)
         if not file_paths:
             raise FileNotFoundError(
                 f"no .txt/.pbtxt graph profiles under {path_to_files}")

@@ -152,7 +152,7 @@ class RampClusterEnvironment:
         # episodes 2+ reuse all partition/lookahead work) and are dropped
         # when the dataset (or num_training_steps, which scales cached
         # lookahead results) changes.
-        sig = self._workload_signature(jobs_config)
+        sig = self._workload_signature()
         if sig != getattr(self, "_cache_signature", object()):
             self._cache_signature = sig
             self.partition_cache: Dict[Tuple[str, int], dict] = {}
@@ -167,49 +167,21 @@ class RampClusterEnvironment:
         self.job_queue.add(self._get_next_job())
         return None
 
-    def _workload_signature(self, jobs_config) -> tuple:
+    def _workload_signature(self) -> tuple:
         """Workload identity for memo-cache validity across resets.
 
         Cached partition/lookahead outcomes depend on the graph files (by
         model name) and on ``num_training_steps`` (which scales cached
         lookahead results); anything else in the jobs config (arrival
-        process, SLA dists, sampling mode) never enters the caches.
-        Synthetic datasets are deterministic per config (seeded
-        generation), so the config content identifies them."""
-        if isinstance(jobs_config, JobsGenerator):
-            return ("generator", self._profile_file_stats(
-                        jobs_config.path_to_files),
-                    jobs_config.num_training_steps,
-                    jobs_config.device_type, jobs_config.max_files)
-        if isinstance(jobs_config, dict):
-            synth = jobs_config.get("synthetic")
-            return ("dict",
-                    self._profile_file_stats(
-                        jobs_config.get("path_to_files")),
-                    jobs_config.get("num_training_steps", 1),
-                    jobs_config.get("device_type", "A100"),
-                    jobs_config.get("max_files"),
-                    repr(sorted(synth.items()))
-                    if isinstance(synth, dict) else None)
-        raise TypeError(
-            f"jobs_config must be a JobsGenerator or a mapping, got "
-            f"{type(jobs_config).__name__}")
-
-    @staticmethod
-    def _profile_file_stats(path: Optional[str]) -> tuple:
-        """(name, mtime, size) of every profile file the generator would
-        load (same discovery rule), so regenerating different profiles at
-        the same path invalidates the caches."""
-        if not path:
-            return ()
-        import os as _os
-
-        from ddls_tpu.demands.jobs_generator import discover_profile_files
-        stats = []
-        for f in discover_profile_files(path):
-            st = _os.stat(f)
-            stats.append((_os.path.basename(f), st.st_mtime_ns, st.st_size))
-        return (path, tuple(stats))
+        process, SLA dists, sampling mode) never enters the caches. The
+        fingerprint is computed by the generator at load time from the
+        exact files it loaded (or the deterministic synthetic config), so
+        later on-disk changes cannot alias two different datasets."""
+        gen = self.jobs_generator
+        fingerprint = getattr(gen, "workload_fingerprint", None)
+        if fingerprint is None:  # duck-typed generator stand-in
+            return ("generator", id(gen))
+        return fingerprint
 
     def _init_step_stats(self) -> dict:
         s = defaultdict(float)
