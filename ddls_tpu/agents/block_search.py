@@ -127,6 +127,24 @@ def first_fit_block(shapes: Sequence[Coord],
     return None
 
 
+def _ramp_arrays(ramp: Dict[Coord, dict], ramp_shape: Coord, job_idx):
+    """C-order mem / blocked views of the snapshot for the C++ kernel.
+    A server is blocked when it holds a job other than ``job_idx``
+    (block_ok's occupancy rule)."""
+    import numpy as np
+
+    rC, rR, rS = ramp_shape
+    mem = np.zeros(rC * rR * rS, np.float64)
+    blocked = np.ones(rC * rR * rS, np.uint8)  # missing cells invalid
+    for (c, r, s), entry in ramp.items():
+        if 0 <= c < rC and 0 <= r < rR and 0 <= s < rS:
+            idx = (c * rR + r) * rS + s
+            mem[idx] = entry["mem"]
+            occ = entry["job_idxs"]
+            blocked[idx] = 1 if (occ and job_idx not in occ) else 0
+    return mem, blocked
+
+
 def find_sub_block(ramp: Dict[Coord, dict],
                    ramp_shape: Coord,
                    meta_shape: Coord,
@@ -136,6 +154,13 @@ def find_sub_block(ramp: Dict[Coord, dict],
     """(reference: placers/utils.py:385-392)"""
     shapes = block_shapes_for(factor_pairs(num_servers), meta_shape)
     shapes += [(num_servers, num_servers, -1), (num_servers, 1, 1)]
+    from ddls_tpu.native import run_first_fit_block
+
+    found = run_first_fit_block(shapes, meta_shape, ramp_shape,
+                                *_ramp_arrays(ramp, ramp_shape, job_idx),
+                                op_size=op_size, meta_scan=False)
+    if found != "unavailable":
+        return found[0] if found else None
     return first_fit_block(shapes, meta_shape, ramp_shape, ramp, job_idx,
                            op_size=op_size)
 
@@ -150,6 +175,16 @@ def find_meta_block(ramp: Dict[Coord, dict],
             ramp_shape[2] - meta_shape[2] + 1)
     if span[0] <= 0 or span[1] <= 0 or span[2] <= 0:
         return None
+    from ddls_tpu.native import run_first_fit_block
+
+    found = run_first_fit_block([meta_shape], meta_shape, ramp_shape,
+                                *_ramp_arrays(ramp, ramp_shape, "__meta__"),
+                                op_size=None, meta_scan=True)
+    if found != "unavailable":
+        if found is None:
+            return None
+        block, origin = found
+        return block, meta_shape, origin
     # meta-mode scans the whole ramp extent (reference: utils.py:176-179)
     for i in range(ramp_shape[0]):
         for j in range(ramp_shape[1]):

@@ -66,6 +66,9 @@ def _compile() -> bool:
                 pass
 
 
+_i64 = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+
+
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ddls_lookahead.restype = None
     lib.ddls_lookahead.argtypes = [
@@ -74,6 +77,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.c_int64, _i32,                          # links, dep_channel
         ctypes.c_int64, ctypes.c_int64,                # workers, channels
         _f64,                                          # out[5]
+    ]
+    lib.ddls_first_fit_block.restype = ctypes.c_int64
+    lib.ddls_first_fit_block.argtypes = [
+        _i64, ctypes.c_int64,                          # shapes [n,3]
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # meta shape
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # ramp shape
+        _f64, _u8,                                     # mem, blocked
+        ctypes.c_double, ctypes.c_int32,               # op_size, check_mem
+        ctypes.c_int32,                                # meta_scan
+        _i64, _i32,                                    # out_origin, out
     ]
     return lib
 
@@ -98,6 +111,48 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return get_lib() is not None
+
+
+def run_first_fit_block(shapes, meta_shape, ramp_shape, mem, blocked,
+                        op_size, meta_scan: bool):
+    """First-fit block search on the C++ kernel.
+
+    ``shapes``: [n, 3] int64 candidate shapes (search order preserved;
+    -1 in the last slot selects the diagonal layout). ``mem``/``blocked``:
+    C-order [C*R*S] views of the ramp snapshot. Returns
+    (list of (c, r, s) coords in enumeration order, origin) or None when
+    nothing fits, or the string "unavailable" when the library is absent
+    (caller falls back to the Python search)."""
+    lib = get_lib()
+    if lib is None:
+        return "unavailable"
+    shapes = np.ascontiguousarray(shapes, np.int64)
+    if shapes.size == 0:
+        return None
+    rC, rR, rS = ramp_shape
+    if meta_scan and (meta_shape[0] > rC or meta_shape[1] > rR
+                      or meta_shape[2] > rS):
+        # a meta block larger than the ramp can never fit (find_meta_block's
+        # span guard); bailing here also keeps the out buffer bound valid
+        return None
+    # worst-case servers a candidate block can cover: the kernel writes
+    # C*R*S cells per attempt (diagonal shapes cover |C| cells; abs also
+    # turns the -1 marker into a safe overestimate)
+    max_block = int(np.abs(shapes).prod(axis=1).max())
+    out = np.empty((max(rC * rR * rS, max_block), 3), np.int32)
+    origin = np.zeros(3, np.int64)
+    n = lib.ddls_first_fit_block(
+        shapes, shapes.shape[0], meta_shape[0], meta_shape[1],
+        meta_shape[2], rC, rR, rS,
+        np.ascontiguousarray(mem, np.float64),
+        np.ascontiguousarray(blocked, np.uint8),
+        float(op_size) if op_size is not None else 0.0,
+        1 if op_size is not None else 0,
+        1 if meta_scan else 0, origin, out)
+    if n == 0:
+        return None
+    block = [tuple(int(x) for x in row) for row in out[:n]]
+    return block, (int(origin[0]), int(origin[1]), int(origin[2]))
 
 
 def run_lookahead(arrays) -> Optional[Tuple[float, float, float, float]]:

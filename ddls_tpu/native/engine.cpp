@@ -234,4 +234,95 @@ void ddls_lookahead(
   out[4] = ok ? 1.0 : 0.0;
 }
 
+// First-fit block search over the RAMP server grid.
+//
+// Exact-order mirror of ddls_tpu/agents/block_search.py
+// (first_fit_block + enumerate_block + block_ok; reference:
+// placers/utils.py:394-443 ff_block): shapes in order, origins in
+// (i, j, k) C-order, cells in enumeration order. shape[2] == -1 selects
+// the diagonal layout whose coordinates wrap modulo (dim + 1) — the
+// reference's quirk — so out-of-range cells invalidate the block.
+// meta_scan == 1 reproduces find_meta_block's whole-extent origin scan
+// (used with a single shape and no memory check).
+//
+// Returns the number of servers written to out ([n][3] coords, in
+// enumeration order), or 0 when no block fits. out_origin receives the
+// winning origin.
+extern "C" int64_t ddls_first_fit_block(
+    const int64_t* shapes, int64_t n_shapes, int64_t mC, int64_t mR,
+    int64_t mS, int64_t rC, int64_t rR, int64_t rS, const double* mem,
+    const uint8_t* blocked, double op_size, int32_t check_mem,
+    int32_t meta_scan, int64_t* out_origin, int32_t* out) {
+  auto cell_ok = [&](int64_t c, int64_t r, int64_t s) -> bool {
+    if (c < 0 || c >= rC || r < 0 || r >= rR || s < 0 || s >= rS)
+      return false;  // host: "server not in ramp"
+    const int64_t idx = (c * rR + r) * rS + s;
+    if (blocked[idx]) return false;
+    if (check_mem && mem[idx] < op_size) return false;
+    return true;
+  };
+
+  for (int64_t si = 0; si < n_shapes; ++si) {
+    const int64_t C = shapes[si * 3], R = shapes[si * 3 + 1],
+                  S = shapes[si * 3 + 2];
+    int64_t i1, j1, k1;
+    if (meta_scan) {
+      i1 = rC;
+      j1 = rR;
+      k1 = rS;
+    } else {
+      i1 = mC - C + 1;
+      j1 = mR - R + 1;
+      k1 = mS - S + 1;
+      if (i1 <= 0 || j1 <= 0 || k1 <= 0) continue;
+    }
+    for (int64_t i = 0; i < i1; ++i)
+      for (int64_t j = 0; j < j1; ++j)
+        for (int64_t k = 0; k < k1; ++k) {
+          int64_t n_out = 0;
+          bool ok = true;
+          if (S == -1) {
+            ok = C > 0;
+            for (int64_t n = 0; ok && n < C; ++n) {
+              const int64_t c = (i + n) % (rC + 1);
+              const int64_t r = (j + n) % (rR + 1);
+              const int64_t s = ((k % rS) + rS) % rS;
+              if (!cell_ok(c, r, s)) {
+                ok = false;
+                break;
+              }
+              out[n_out * 3] = static_cast<int32_t>(c);
+              out[n_out * 3 + 1] = static_cast<int32_t>(r);
+              out[n_out * 3 + 2] = static_cast<int32_t>(s);
+              ++n_out;
+            }
+          } else {
+            ok = C > 0 && R > 0 && S > 0;
+            for (int64_t c = 0; ok && c < C; ++c)
+              for (int64_t r = 0; ok && r < R; ++r)
+                for (int64_t s = 0; s < S; ++s) {
+                  const int64_t cc = (i + c) % rC;
+                  const int64_t rr = (j + r) % rR;
+                  const int64_t ss = (k + s) % rS;
+                  if (!cell_ok(cc, rr, ss)) {
+                    ok = false;
+                    break;
+                  }
+                  out[n_out * 3] = static_cast<int32_t>(cc);
+                  out[n_out * 3 + 1] = static_cast<int32_t>(rr);
+                  out[n_out * 3 + 2] = static_cast<int32_t>(ss);
+                  ++n_out;
+                }
+          }
+          if (ok && n_out > 0) {
+            out_origin[0] = i;
+            out_origin[1] = j;
+            out_origin[2] = k;
+            return n_out;
+          }
+        }
+  }
+  return 0;
+}
+
 }  // extern "C"

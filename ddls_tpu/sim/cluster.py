@@ -177,10 +177,13 @@ class RampClusterEnvironment:
         fingerprint is computed by the generator at load time from the
         exact files it loaded (or the deterministic synthetic config), so
         later on-disk changes cannot alias two different datasets."""
-        gen = self.jobs_generator
-        fingerprint = getattr(gen, "workload_fingerprint", None)
-        if fingerprint is None:  # duck-typed generator stand-in
-            return ("generator", id(gen))
+        fingerprint = getattr(self.jobs_generator, "workload_fingerprint",
+                              None)
+        if fingerprint is None:
+            # duck-typed generator stand-in with no fingerprint: a fresh
+            # sentinel never matches, so the caches are always cleared
+            # (id()-based identity could alias two workloads after GC)
+            return ("no-fingerprint", object())
         return fingerprint
 
     def _init_step_stats(self) -> dict:

@@ -160,3 +160,67 @@ def test_native_matches_numpy_reference_on_random_instances():
         assert got == pytest.approx(expected[:4], rel=0, abs=0), \
             f"case {case}: {got} != {expected[:4]}"
     assert solved > 200, f"only {solved} solvable instances generated"
+
+
+def test_native_block_search_matches_python():
+    """The C++ first-fit block search reproduces the Python search
+    (shapes -> origins -> cells, first fit) exactly on random snapshots,
+    including the diagonal layout and meta-mode whole-extent scans."""
+    from ddls_tpu.agents.block_search import (block_shapes_for,
+                                              enumerate_block, block_ok,
+                                              factor_pairs,
+                                              first_fit_block,
+                                              _ramp_arrays)
+    from ddls_tpu.native import run_first_fit_block
+
+    rng = np.random.RandomState(1)
+    for case in range(200):
+        ramp_shape = (int(rng.randint(1, 5)), int(rng.randint(1, 5)),
+                      int(rng.randint(1, 3)))
+        ramp = {}
+        for c in range(ramp_shape[0]):
+            for r in range(ramp_shape[1]):
+                for s in range(ramp_shape[2]):
+                    occ = set()
+                    if rng.rand() < 0.3:
+                        occ.add(int(rng.randint(0, 3)))
+                    ramp[(c, r, s)] = {
+                        "mem": float(rng.randint(0, 5)),
+                        "job_idxs": occ}
+        meta_shape = (int(rng.randint(1, ramp_shape[0] + 1)),
+                      int(rng.randint(1, ramp_shape[1] + 1)),
+                      int(rng.randint(1, ramp_shape[2] + 1)))
+        job_idx = int(rng.randint(0, 3))
+        num_servers = int(rng.randint(1, 7))
+        op_size = float(rng.randint(0, 4))
+
+        shapes = block_shapes_for(factor_pairs(num_servers), meta_shape)
+        shapes += [(num_servers, num_servers, -1), (num_servers, 1, 1)]
+        expected = first_fit_block(shapes, meta_shape, ramp_shape, ramp,
+                                   job_idx, op_size=op_size)
+        got = run_first_fit_block(shapes, meta_shape, ramp_shape,
+                                  *_ramp_arrays(ramp, ramp_shape, job_idx),
+                                  op_size=op_size, meta_scan=False)
+        assert got != "unavailable"
+        assert (got[0] if got else None) == expected, f"case {case}"
+
+        # meta-mode parity
+        expected_meta = None
+        for i in range(ramp_shape[0]):
+            for j in range(ramp_shape[1]):
+                for k in range(ramp_shape[2]):
+                    block = enumerate_block(meta_shape, ramp_shape,
+                                            (i, j, k))
+                    if block_ok(ramp, block, None, job_idx="__meta__"):
+                        expected_meta = (block, (i, j, k))
+                        break
+                if expected_meta:
+                    break
+            if expected_meta:
+                break
+        got_meta = run_first_fit_block(
+            [meta_shape], meta_shape, ramp_shape,
+            *_ramp_arrays(ramp, ramp_shape, "__meta__"),
+            op_size=None, meta_scan=True)
+        assert got_meta != "unavailable"
+        assert got_meta == expected_meta, f"meta case {case}"
