@@ -85,3 +85,37 @@ def test_shipped_heuristic_config_composes():
     assert loop_cfg["_target_"].endswith("EvalLoop")
     assert loop_cfg["actor"]["_target_"].endswith("AcceptableJCT")
     assert loop_cfg["env"]["max_partitions_per_op"] == 16
+
+
+def test_all_shipped_env_configs_cap_edge_padding():
+    """Every shipped env/heuristic config must set pad_obs_kwargs.max_edges:
+    the parity default is the fully-connected bound (11,175 edges for 150
+    nodes), which drags ~20x dead padding through every GNN forward
+    (docs/perf_round2.md). This pins the round-2 lesson."""
+    import glob
+    import os
+
+    import yaml
+
+    scripts = os.path.join(os.path.dirname(__file__), "..", "scripts")
+    checked = 0
+    for cfg_path in glob.glob(os.path.join(scripts, "*_configs",
+                                           "**", "*.yaml"), recursive=True):
+        with open(cfg_path) as f:
+            cfg = yaml.safe_load(f)
+        if not isinstance(cfg, dict):
+            continue
+        # pad_obs_kwargs appears either at top level (env_config group
+        # files) or nested under eval_loop.env (heuristic configs)
+        blocks = []
+        if "pad_obs_kwargs" in cfg:
+            blocks.append(cfg["pad_obs_kwargs"])
+        env = (cfg.get("eval_loop") or {}).get("env") or {}
+        if "pad_obs_kwargs" in env:
+            blocks.append(env["pad_obs_kwargs"])
+        for block in blocks:
+            checked += 1
+            assert block.get("max_edges"), (
+                f"{cfg_path}: pad_obs_kwargs must set max_edges (the "
+                "fully-connected default is a ~20x perf trap)")
+    assert checked >= 4, "expected to find padded env configs to check"
