@@ -93,26 +93,27 @@ def test_all_shipped_env_configs_cap_edge_padding():
     nodes), which drags ~20x dead padding through every GNN forward
     (docs/perf_round2.md). This pins the round-2 lesson."""
     import glob
-    import os
 
     import yaml
 
-    scripts = os.path.join(os.path.dirname(__file__), "..", "scripts")
+    def walk(node, found):
+        if isinstance(node, dict):
+            for key, value in node.items():
+                if key == "pad_obs_kwargs" and isinstance(value, dict):
+                    found.append(value)
+                else:
+                    walk(value, found)
+        elif isinstance(node, list):
+            for item in node:
+                walk(item, found)
+
     checked = 0
-    for cfg_path in glob.glob(os.path.join(scripts, "*_configs",
+    for cfg_path in glob.glob(os.path.join(REPO, "scripts", "*_configs",
                                            "**", "*.yaml"), recursive=True):
         with open(cfg_path) as f:
             cfg = yaml.safe_load(f)
-        if not isinstance(cfg, dict):
-            continue
-        # pad_obs_kwargs appears either at top level (env_config group
-        # files) or nested under eval_loop.env (heuristic configs)
-        blocks = []
-        if "pad_obs_kwargs" in cfg:
-            blocks.append(cfg["pad_obs_kwargs"])
-        env = (cfg.get("eval_loop") or {}).get("env") or {}
-        if "pad_obs_kwargs" in env:
-            blocks.append(env["pad_obs_kwargs"])
+        blocks: list = []
+        walk(cfg, blocks)
         for block in blocks:
             checked += 1
             assert block.get("max_edges"), (
