@@ -50,13 +50,10 @@ class SRPTDepScheduler:
         # global SRPT ordering over all newly placed flow deps, priced by the
         # comm model (reference sorts all jobdeps together,
         # srpt_dep_scheduler.py:66-77). Costs come straight from the priced
-        # array and the descending sort is one stable argsort. Tie order can
-        # differ from the tuple-sort original only for non-flow deps (the
-        # fast path visits them in edge order rather than placer-insertion
-        # order) — safe because non-flow priorities land exclusively on the
-        # None channel that no engine reads, while flows keep their relative
-        # order in every tie class (per-job edge order, jobs in action
-        # order) in both paths.
+        # array and the descending sort is one stable argsort. Both paths
+        # visit deps in graph edge order (per job, jobs in action order), so
+        # every tie class — including a flow priced exactly 0.0 — resolves
+        # identically whether or not dep_init_run_time_arr is present.
         jobs, deps_lists, costs_list = [], [], []
         for job_id, dep_to_channels in dep_placement.action.items():
             job = op_partition.partitioned_jobs[job_id]
@@ -69,7 +66,13 @@ class SRPTDepScheduler:
             if arr is not None and len(dep_to_channels) == len(edge_ids):
                 deps, costs = edge_ids, arr
             else:
-                deps = list(dep_to_channels)
+                # iterate in graph edge order so ties (e.g. a flow priced
+                # exactly 0.0) land in the same position as the fast path;
+                # any placer-added key outside the edge list goes last
+                deps = [d for d in edge_ids if d in dep_to_channels]
+                if len(deps) != len(dep_to_channels):
+                    seen = set(deps)
+                    deps += [d for d in dep_to_channels if d not in seen]
                 costs = np.array(
                     [job.dep_init_run_time.get(d, 0.0) for d in deps],
                     np.float64)

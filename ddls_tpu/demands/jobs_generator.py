@@ -19,6 +19,7 @@ Additions over the reference:
 from __future__ import annotations
 
 import glob
+import hashlib
 import os
 import random
 import tempfile
@@ -126,28 +127,39 @@ class JobsGenerator:
 
         file_paths = (sorted(generated_paths) if generated_paths is not None
                       else discover_profile_files(path_to_files))
+        if not file_paths:
+            raise FileNotFoundError(
+                f"no .txt/.pbtxt graph profiles under {path_to_files}")
+        if max_files is not None:
+            file_paths = file_paths[:max_files]
         # workload fingerprint for the cluster's memo-cache validity check:
         # synthetic datasets are deterministic per config (seeded), so the
         # config content identifies them regardless of the tmpdir they were
-        # written to; on-disk datasets fingerprint the exact files loaded,
-        # statted at load time (not at reset time — the files could change
-        # on disk after this generator read them)
+        # written to; on-disk datasets fingerprint exactly the files loaded
+        # (post-max_files truncation), statted+digested at load time (not at
+        # reset time — the files could change on disk after this generator
+        # read them)
         if synthetic is not None:
             dataset_id = ("synthetic", repr(sorted(synthetic.items())))
         else:
             stats = []
             for f in file_paths:
                 st = os.stat(f)
+                # content digest of head+tail bytes makes the check
+                # content-true: an in-place edit that preserves mtime and
+                # size (some sync tools, archive extraction) still changes
+                # the fingerprint and invalidates stale memo caches
+                with open(f, "rb") as fh:
+                    head = fh.read(4096)
+                    if st.st_size > 8192:
+                        fh.seek(-4096, os.SEEK_END)
+                    tail = fh.read(4096)
+                digest = hashlib.sha1(head + tail).hexdigest()
                 stats.append((os.path.basename(f), st.st_mtime_ns,
-                              st.st_size))
+                              st.st_size, digest))
             dataset_id = ("files", path_to_files, tuple(stats))
         self.workload_fingerprint = (dataset_id, num_training_steps,
                                      device_type, max_files)
-        if not file_paths:
-            raise FileNotFoundError(
-                f"no .txt/.pbtxt graph profiles under {path_to_files}")
-        if max_files is not None:
-            file_paths = file_paths[:max_files]
 
         self.interarrival_dist = make_distribution(job_interarrival_time_dist)
         frac_dist = make_distribution(

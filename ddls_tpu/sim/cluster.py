@@ -379,7 +379,6 @@ class RampClusterEnvironment:
             t += tick
 
             if state.is_training_step_complete():
-                job.training_step_counter += 1
                 break
 
         steps = job.num_training_steps
@@ -432,6 +431,12 @@ class RampClusterEnvironment:
                 if cached is None:  # disabled, or padding/shape fallback
                     cached = self._run_lookahead(job)
                 self.lookahead_cache[key] = cached
+            # one simulated training step happened for this job, whichever
+            # backend (host/native/jax) served it and whether or not the
+            # memo cache did — keeps job.training_step_counter meaningful
+            # independent of engine choice (RAMP-path completion itself is
+            # event-driven off the lookahead JCT, not this counter)
+            job.training_step_counter += 1
             jct, comm_oh, comp_oh, busy = cached
             self._register_completed_lookahead(job, jct, comm_oh, comp_oh,
                                                busy)

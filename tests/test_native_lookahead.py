@@ -48,7 +48,7 @@ def _env_kwargs(tmp_path, **overrides):
         reward_function="job_acceptance",
         reward_function_kwargs={"fail_reward": -1, "success_reward": 1},
         max_simulation_run_time=1e6,
-        pad_obs_kwargs={"max_nodes": 150})
+        pad_obs_kwargs={"max_nodes": 150, "max_edges": 512})
     kwargs.update(overrides)
     return kwargs
 
