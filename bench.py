@@ -31,6 +31,34 @@ import numpy as np
 REFERENCE_ENV_STEPS_PER_SEC = 240.0  # documented estimate, see module docstring
 BASELINE_SOURCE = "estimate"  # reference publishes no numbers (BASELINE.json)
 
+# dense peak FLOPs/s per chip by device kind, bf16 convention (the MXU's
+# native matmul precision; MFU reported against it is the standard yardstick).
+# Sources: public TPU spec sheets. CPU has no meaningful peak -> MFU null.
+PEAK_FLOPS_BY_DEVICE_KIND = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,   # v5e
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,   # v6e / Trillium
+    "TPU v6e": 918e12,
+}
+
+
+def update_cost_analysis(jitted, *args) -> float | None:
+    """FLOPs of one update step via XLA cost analysis on the *lowered*
+    (uncompiled) computation — tracing is cheap, and avoiding ``.compile()``
+    avoids a second full XLA compile of the scanned SGD update, which would
+    eat minutes of the driver's bench budget. Returns None where the
+    backend doesn't support cost analysis."""
+    try:
+        cost = jitted.lower(*args).cost_analysis()
+        if isinstance(cost, list):  # one dict per device program
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
 
 def emit(payload: dict) -> None:
     """The driver parses exactly one JSON line from stdout."""
@@ -219,26 +247,40 @@ def run_bench(args, platform_note: str | None,
     state = learner.init_state(params)
     collector = RolloutCollector(vec, learner, args.rollout_length)
 
+    update_time = [0.0]
+
     def one_epoch(state, rng):
         # params stay on device: sample_actions reads them in place rather
         # than re-uploading the whole tree every rollout step
         out = collector.collect(state.params, rng)
         straj, slv = learner.shard_traj(out["traj"], out["last_values"])
+        tu = time.perf_counter()
         state, metrics = learner.train_step(state, straj, slv, rng)
         jax.block_until_ready(metrics["total_loss"])
-        return state, out["env_steps"]
+        update_time[0] += time.perf_counter() - tu
+        return state, out["env_steps"], (straj, slv)
 
     rng = jax.random.PRNGKey(1)
+    update_args = None
     for i in range(args.warmup_epochs):
         rng, sub = jax.random.split(rng)
-        state, _ = one_epoch(state, sub)
+        state, _, update_args = one_epoch(state, sub)
 
+    # FLOPs of ONE compiled update step (cached compile: same shapes as the
+    # warmed-up call). Grabbed before timing so it can't perturb the clock.
+    update_flops = None
+    if update_args is not None:
+        straj, slv = update_args
+        update_flops = update_cost_analysis(
+            learner._jit_train_step, state, straj, slv, rng)
+
+    update_time[0] = 0.0
     t0 = time.perf_counter()
     total_steps = 0
     epochs_run = 0
     for i in range(args.timed_epochs):
         rng, sub = jax.random.split(rng)
-        state, n = one_epoch(state, sub)
+        state, n, _ = one_epoch(state, sub)
         total_steps += n
         epochs_run += 1
         # a measurement must always land inside the driver's budget; the
@@ -250,19 +292,33 @@ def run_bench(args, platform_note: str | None,
 
     vec.close()
     value = total_steps / dt
+    dev = jax.devices()[0]
     payload = {
         "metric": "ppo_env_steps_per_sec",
         "value": round(value, 2),
         "unit": "env_steps/s",
         "vs_baseline": round(value / REFERENCE_ENV_STEPS_PER_SEC, 3),
         "baseline_source": BASELINE_SOURCE,
-        "platform": jax.devices()[0].platform,
+        "platform": dev.platform,
         "num_envs": args.num_envs,  # after device-multiple rounding
         "rollout_length": args.rollout_length,
         "num_sgd_iter": args.num_sgd_iter,
         "timed_epochs": epochs_run,
         "cores": _available_cores(),
     }
+    # achieved FLOPs / MFU of the jitted sharded update (VERDICT round-2
+    # weakness 2: "fast" must mean something on the chip, not just vs the
+    # invented 240 env-steps/s denominator)
+    if epochs_run and update_time[0] > 0:
+        payload["update_ms"] = round(update_time[0] / epochs_run * 1e3, 2)
+        if update_flops is not None:
+            achieved = update_flops * epochs_run / update_time[0]
+            payload["update_flops"] = update_flops
+            payload["update_gflops_per_sec"] = round(achieved / 1e9, 2)
+            peak = PEAK_FLOPS_BY_DEVICE_KIND.get(
+                getattr(dev, "device_kind", ""))
+            payload["mfu"] = (round(achieved / peak, 4)
+                              if peak else None)
     if platform_note:
         payload["platform_note"] = platform_note
     return payload

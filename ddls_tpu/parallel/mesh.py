@@ -23,12 +23,15 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_mesh(n_devices: Optional[int] = None,
               axis_names: Sequence[str] = ("dp",),
-              devices=None) -> Mesh:
+              devices=None,
+              shape: Optional[Sequence[int]] = None) -> Mesh:
     """Build a mesh over the first ``n_devices`` devices.
 
     With one axis name the mesh is a 1-D data-parallel mesh; more axis names
     split the device count into factors, largest-last (e.g. ``("dp", "tp")``
-    with 8 devices -> dp=2, tp=4).
+    with 8 devices -> dp=2, tp=4). Pass ``shape`` (one int per axis name,
+    product = device count) to pick the factorisation explicitly, e.g.
+    ``make_mesh(8, ("dp", "mp"), shape=(4, 2))``.
     """
     devices = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
@@ -38,13 +41,20 @@ def make_mesh(n_devices: Optional[int] = None,
                 "are available")
         devices = devices[:n_devices]
     n = len(devices)
-    shape = []
-    remaining = n
-    for _ in axis_names[:-1]:
-        f = _largest_factor_leq(remaining, int(np.sqrt(remaining)))
-        shape.append(f)
-        remaining //= f
-    shape.append(remaining)
+    if shape is not None:
+        shape = list(shape)
+        if len(shape) != len(axis_names) or int(np.prod(shape)) != n:
+            raise ValueError(
+                f"mesh shape {shape} does not factor {n} devices over "
+                f"axes {tuple(axis_names)}")
+    else:
+        shape = []
+        remaining = n
+        for _ in axis_names[:-1]:
+            f = _largest_factor_leq(remaining, int(np.sqrt(remaining)))
+            shape.append(f)
+            remaining //= f
+        shape.append(remaining)
     mesh_devices = np.asarray(devices).reshape(shape)
     return Mesh(mesh_devices, axis_names)
 
@@ -65,6 +75,34 @@ def batch_sharding(mesh: Mesh, batch_axis: int = 0,
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def mp_tree_shardings(mesh: Mesh, tree, axis_name: str = "mp",
+                      min_size: int = 0):
+    """Tensor-parallel shardings for a parameter (or train-state) pytree.
+
+    Shape-based rule, applied per leaf: a dense kernel (ndim >= 2) whose
+    last (output-feature) dimension divides the ``axis_name`` mesh axis and
+    whose size reaches ``min_size`` is sharded over that dimension; every
+    other leaf (biases, scalars, counters) is replicated. Because the rule
+    depends only on leaf shape, optimiser moments (adam mu/nu mirror the
+    params tree) pick up exactly the params' layout, so one ``tree_map``
+    covers a whole TrainState. XLA's GSPMD partitioner then emits the
+    activation all-gathers / gradient reduce-scatters over ``axis_name``
+    from these annotations alone — the TPU-native counterpart of
+    hand-written tensor-parallel NCCL collectives.
+    """
+    size = mesh.shape[axis_name]
+
+    def rule(x):
+        shp = getattr(x, "shape", ())
+        if (len(shp) >= 2 and shp[-1] % size == 0
+                and int(np.prod(shp)) >= min_size):
+            return NamedSharding(
+                mesh, P(*([None] * (len(shp) - 1) + [axis_name])))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, tree)
 
 
 def shard_batch(mesh: Mesh, tree, batch_axis: int = 0,

@@ -148,10 +148,18 @@ class PPOLearner:
     ``ddls_tpu.models.policy.batched_policy_apply``).
     """
 
-    def __init__(self, apply_fn: Callable, cfg: PPOConfig, mesh):
+    def __init__(self, apply_fn: Callable, cfg: PPOConfig, mesh,
+                 shard_params_axis: str | None = None):
         self.apply_fn = apply_fn
         self.cfg = cfg
         self.mesh = mesh
+        # optional tensor parallelism: name a second mesh axis (e.g. "mp")
+        # and eligible dense kernels are sharded over their output-feature
+        # dim (parallel/mesh.py mp_tree_shardings); XLA emits the tp
+        # collectives from the annotations. None = replicate (the default
+        # 1-D dp plan; the policy net is small enough that dp alone is
+        # usually right — SURVEY §2.10 MP row)
+        self.shard_params_axis = shard_params_axis
         chain = []
         if cfg.grad_clip is not None:
             chain.append(optax.clip_by_global_norm(cfg.grad_clip))
@@ -161,13 +169,20 @@ class PPOLearner:
         self._replicated = replicated_sharding(mesh)
         self._batch_time = NamedSharding(mesh, P(None, "dp"))
         self._batch_only = NamedSharding(mesh, P("dp"))
-        self._jit_train_step = jax.jit(
-            self._train_step,
-            in_shardings=(self._replicated, self._batch_time,
-                          self._batch_only, self._replicated),
-            out_shardings=(self._replicated, self._replicated),
-            donate_argnums=(0,))
+        self._jit_train_step = None  # built per state layout in init_state
+        self._jit_cache = {}  # state-layout key -> compiled jit wrapper
         self._jit_sample = jax.jit(self._sample_actions)
+
+    def _state_shardings(self, state):
+        """Sharding tree for a TrainState: replicated, or tp-sharded by the
+        shape-based rule (which covers params and their adam moments
+        identically)."""
+        if self.shard_params_axis is None:
+            return self._replicated
+        from ddls_tpu.parallel.mesh import mp_tree_shardings
+
+        return mp_tree_shardings(self.mesh, state,
+                                 axis_name=self.shard_params_axis)
 
     # ------------------------------------------------------------- state
     def init_state(self, params) -> TrainState:
@@ -175,7 +190,23 @@ class PPOLearner:
         # alone can alias the caller's arrays (which donation would delete)
         params = jax.tree_util.tree_map(jnp.copy, params)
         state = TrainState.create(params, self.tx, self.cfg.kl_coeff)
-        return jax.device_put(state, self._replicated)
+        shardings = self._state_shardings(state)
+        # memoise the jit wrapper per state layout: a fresh jax.jit object
+        # has an empty executable cache, so rebuilding it on every
+        # init_state would recompile the scanned SGD update even when the
+        # layout is unchanged (e.g. re-initialising params between trials)
+        key = (jax.tree_util.tree_structure(state),
+               tuple(str(getattr(s, "spec", s)) for s in
+                     jax.tree_util.tree_leaves(shardings)))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(
+                self._train_step,
+                in_shardings=(shardings, self._batch_time,
+                              self._batch_only, self._replicated),
+                out_shardings=(shardings, self._replicated),
+                donate_argnums=(0,))
+        self._jit_train_step = self._jit_cache[key]
+        return jax.device_put(state, shardings)
 
     # ------------------------------------------------------------ acting
     def _sample_actions(self, params, obs, rng):
@@ -284,6 +315,9 @@ class PPOLearner:
                    last_values, rng):
         """Jitted sharded update. ``traj`` leaves are [T, B, ...] with the
         B axis sharded over the mesh's dp axis (see shard_traj)."""
+        if self._jit_train_step is None:
+            raise RuntimeError("call init_state() before train_step(): the "
+                               "update is compiled for the state's layout")
         return self._jit_train_step(state, traj, last_values, rng)
 
     def shard_traj(self, traj: Dict[str, Any], last_values):

@@ -159,6 +159,60 @@ def test_sharded_update_matches_single_device(model_and_params):
         np.testing.assert_allclose(m1[k], m8[k], rtol=2e-4, atol=2e-5)
 
 
+def test_tp_sharded_update_matches_single_device(model_and_params):
+    """PPO on a 2-D (dp, mp) mesh with tensor-parallel parameter shardings
+    (mp_tree_shardings) is the same program as the single-device update:
+    with full-batch minibatches the composition trick of the dp test
+    applies, so (4,2) must agree with 1 device numerically."""
+    from ddls_tpu.parallel.mesh import mp_tree_shardings
+
+    model, params = model_and_params
+    rng = np.random.RandomState(4)
+    traj = _fake_traj(rng, T=4, B=16)
+    last_values = rng.randn(16).astype(np.float32)
+
+    results = []
+    for n_dev, axes, shape, tp in ((1, ("dp",), None, None),
+                                   (8, ("dp", "mp"), (4, 2), "mp")):
+        mesh = make_mesh(n_dev, axes, shape=shape)
+        learner = PPOLearner(
+            lambda p, o: batched_policy_apply(model, p, o),
+            PPOConfig(num_sgd_iter=2, sgd_minibatch_size=64, grad_clip=0.5),
+            mesh, shard_params_axis=tp)
+        state = learner.init_state(params)
+        if tp is not None:
+            specs = [str(getattr(x.sharding, "spec", ""))
+                     for x in jax.tree_util.tree_leaves(state.params)]
+            assert any("mp" in s for s in specs), specs
+        straj, slv = learner.shard_traj(traj, last_values)
+        new_state, metrics = learner.train_step(state, straj, slv,
+                                                jax.random.PRNGKey(5))
+        results.append((jax.device_get(new_state.params),
+                        jax.device_get(metrics)))
+    p1, m1 = results[0]
+    ptp, mtp = results[1]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        p1, ptp)
+    for k in m1:
+        np.testing.assert_allclose(m1[k], mtp[k], rtol=2e-4, atol=2e-5)
+
+
+def test_mesh_explicit_shape_and_mp_rule():
+    from ddls_tpu.parallel.mesh import mp_tree_shardings
+
+    mesh = make_mesh(8, ("dp", "mp"), shape=(4, 2))
+    assert mesh.shape["dp"] == 4 and mesh.shape["mp"] == 2
+    with pytest.raises(ValueError, match="factor"):
+        make_mesh(8, ("dp", "mp"), shape=(3, 2))
+    tree = {"kernel": np.zeros((6, 4)), "bias": np.zeros((4,)),
+            "odd": np.zeros((5, 3)), "scalar": np.zeros(())}
+    specs = mp_tree_shardings(mesh, tree, axis_name="mp")
+    assert "mp" in str(specs["kernel"].spec)
+    assert str(specs["bias"].spec) == str(specs["scalar"].spec)
+    assert "mp" not in str(specs["odd"].spec)  # 3 not divisible by 2
+
+
 def test_masked_actions_never_sampled(model_and_params):
     model, params = model_and_params
     mesh = make_mesh(1)
