@@ -315,9 +315,12 @@ def run_bench(args, platform_note: str | None,
             achieved = update_flops * epochs_run / update_time[0]
             payload["update_flops"] = update_flops
             payload["update_gflops_per_sec"] = round(achieved / 1e9, 2)
+            # the lowered cost analysis counts the GLOBAL computation's
+            # FLOPs (pre-partitioning), so the aggregate rate is divided by
+            # the aggregate peak of every chip the mesh spans
             peak = PEAK_FLOPS_BY_DEVICE_KIND.get(
                 getattr(dev, "device_kind", ""))
-            payload["mfu"] = (round(achieved / peak, 4)
+            payload["mfu"] = (round(achieved / (peak * n_dev), 4)
                               if peak else None)
     if platform_note:
         payload["platform_note"] = platform_note

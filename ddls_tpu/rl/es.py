@@ -36,8 +36,16 @@ class ESConfig:
     l2_coeff: float = 0.005
     episodes_per_batch: int = 1000
     report_length: int = 10
-    eval_prob: float = 0.03        # carried for config parity
-    action_noise_std: float = 0.01  # carried for config parity
+    # probability that an epoch also evaluates the UNPERTURBED mean params
+    # (reported as eval_fitness_mean, never folded into the gradient) —
+    # RLlib's eval_prob marks whole worker rollouts as eval rollouts; here
+    # the unit of evaluation is an epoch's interaction window
+    eval_prob: float = 0.03
+    # exploration noise on the policy's action logits during fitness
+    # rollouts. RLlib's action_noise_std perturbs continuous actions
+    # directly; the discrete-action analogue is Gaussian logit noise ahead
+    # of the argmax (0 = deterministic greedy, the old behaviour)
+    action_noise_std: float = 0.01
     train_batch_size: int = 2000
 
 
@@ -110,19 +118,38 @@ class ESLearner:
     def perturb(self, params, rng):
         return self._jit_perturb(params, rng)
 
-    def _pop_actions(self, stacked_params, obs):
-        """Greedy action for each member on its own env: obs leaves are
-        [P, ...]; one vmapped forward covers the population."""
+    def _pop_actions(self, stacked_params, obs, rng, noise_std):
+        """Action for each member on its own env: obs leaves are [P, ...];
+        one vmapped forward covers the population. ``noise_std`` Gaussian
+        noise lands on the logits before the argmax (discrete analogue of
+        RLlib's action-space noise; a traced scalar so 0.0 and >0 share one
+        compiled kernel). Masked logits sit at -inf or at GNNPolicy's
+        finfo.min clamp (models/policy.py:93-97) — either way ~1e38 below
+        any valid logit, an offset Gaussian noise cannot bridge, so noise
+        never unmasks an invalid action."""
 
-        def one(member_params, member_obs):
+        def one(member_params, member_obs, member_rng):
             batched = jax.tree_util.tree_map(lambda x: x[None], member_obs)
             logits, _ = self.apply_fn(member_params, batched)
-            return jnp.argmax(logits[0], axis=-1)
+            logits = logits[0]
+            logits = logits + noise_std * jax.random.normal(
+                member_rng, logits.shape, logits.dtype)
+            return jnp.argmax(logits, axis=-1)
 
-        return jax.vmap(one)(stacked_params, obs)
+        keys = jax.random.split(rng, self.population)
+        return jax.vmap(one)(stacked_params, obs, keys)
 
-    def pop_actions(self, stacked_params, obs):
-        return self._jit_pop_actions(stacked_params, obs)
+    def pop_actions(self, stacked_params, obs, rng=None, noise_std=None):
+        if rng is None:
+            # deterministic-greedy convenience path (the pre-noise API):
+            # without a caller rng there is no honest randomness, so noise
+            # is forced off rather than replaying one frozen key's pattern
+            rng = jax.random.PRNGKey(0)
+            noise_std = 0.0
+        if noise_std is None:
+            noise_std = self.cfg.action_noise_std
+        return self._jit_pop_actions(stacked_params, obs, rng,
+                                     jnp.float32(noise_std))
 
     # ------------------------------------------------------------ update
     def _update(self, state: ESState, eps, fitness):
@@ -156,16 +183,35 @@ class ESLearner:
                                                         jnp.float32))
 
     # --------------------------------------------------------- evaluation
-    def evaluate_population(self, stacked_params, vec_env,
-                            window: int) -> np.ndarray:
+    def evaluate_population(self, stacked_params, vec_env, window: int,
+                            rng=None, noise_std=None) -> np.ndarray:
         """Run every env for ``window`` steps, env i driven by member i;
-        returns summed rewards [P]."""
+        returns summed rewards [P]. ``rng`` seeds the per-step action
+        noise (``noise_std``, default cfg.action_noise_std)."""
+        import jax as _jax
+
         from ddls_tpu.rl.rollout import stack_obs
 
+        if rng is None:
+            rng = _jax.random.PRNGKey(0)
         fitness = np.zeros(self.population, dtype=np.float64)
         for _ in range(window):
+            rng, sub = _jax.random.split(rng)
             obs = stack_obs(vec_env.obs)
-            actions = np.asarray(self.pop_actions(stacked_params, obs))
+            actions = np.asarray(self.pop_actions(stacked_params, obs, sub,
+                                                  noise_std=noise_std))
             _, rewards, _ = vec_env.step(actions)
             fitness += rewards
         return fitness
+
+    def evaluate_mean_params(self, params, vec_env, window: int,
+                             rng=None) -> float:
+        """Fitness of the UNPERTURBED params (cfg.eval_prob hook): every
+        env runs the same mean parameters, noise-free; returns the mean
+        summed reward across envs."""
+        stacked = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x[None], (self.population,) + x.shape),
+            params)
+        fitness = self.evaluate_population(stacked, vec_env, window, rng,
+                                           noise_std=0.0)
+        return float(np.mean(fitness))
