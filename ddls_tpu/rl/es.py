@@ -143,7 +143,12 @@ class ESLearner:
         if rng is None:
             # deterministic-greedy convenience path (the pre-noise API):
             # without a caller rng there is no honest randomness, so noise
-            # is forced off rather than replaying one frozen key's pattern
+            # is off — and asking for noise without an rng is an error,
+            # not a silent override
+            if noise_std:
+                raise ValueError(
+                    "pop_actions(noise_std > 0) needs an rng; without one "
+                    "the same frozen noise pattern would repeat every call")
             rng = jax.random.PRNGKey(0)
             noise_std = 0.0
         if noise_std is None:
@@ -188,15 +193,13 @@ class ESLearner:
         """Run every env for ``window`` steps, env i driven by member i;
         returns summed rewards [P]. ``rng`` seeds the per-step action
         noise (``noise_std``, default cfg.action_noise_std)."""
-        import jax as _jax
-
         from ddls_tpu.rl.rollout import stack_obs
 
         if rng is None:
-            rng = _jax.random.PRNGKey(0)
+            rng = jax.random.PRNGKey(0)
         fitness = np.zeros(self.population, dtype=np.float64)
         for _ in range(window):
-            rng, sub = _jax.random.split(rng)
+            rng, sub = jax.random.split(rng)
             obs = stack_obs(vec_env.obs)
             actions = np.asarray(self.pop_actions(stacked_params, obs, sub,
                                                   noise_std=noise_std))

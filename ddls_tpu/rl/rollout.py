@@ -109,6 +109,20 @@ class VectorEnv:
         out, self.completed_episodes = self.completed_episodes, []
         return out
 
+    def restart_episodes(self) -> List[Dict[str, np.ndarray]]:
+        """Abandon every in-progress episode and start fresh ones on
+        advanced per-env seeds. Completed-episode records are kept; the
+        abandoned partial returns/lengths are dropped — used after an
+        off-policy interlude (e.g. an ES eval window) so foreign-policy
+        steps can never leak into training episode stats."""
+        for i in range(self.num_envs):
+            self.seeds[i] += self.num_envs
+        self.obs = [env.reset(seed=self.seeds[i])
+                    for i, env in enumerate(self.envs)]
+        self.episode_returns[:] = 0.0
+        self.episode_lengths[:] = 0
+        return self.obs
+
     def close(self) -> None:
         pass
 
@@ -128,7 +142,12 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
         while True:
             cmd, payload = conn.recv()
             if cmd == "reset":
-                seed = payload if payload is not None else seed
+                if payload is not None:
+                    seed = payload
+                else:
+                    # seedless reset = restart: advance to a fresh workload
+                    # rather than replaying the abandoned episode's seed
+                    seed += seed_stride
                 obs = env.reset(seed=seed)
                 episode_return, episode_length = 0.0, 0
                 conn.send(("obs", obs))
@@ -226,6 +245,16 @@ class ParallelVectorEnv:
     def drain_completed_episodes(self) -> List[Dict[str, Any]]:
         out, self.completed_episodes = self.completed_episodes, []
         return out
+
+    def restart_episodes(self) -> List[Dict[str, np.ndarray]]:
+        """See VectorEnv.restart_episodes: workers advance their own seeds
+        on a seedless reset and drop partial episode accumulators."""
+        if self._first_reset:
+            return self.reset()
+        for conn in self._conns:
+            conn.send(("reset", None))
+        self.obs = [self._recv(conn)[1] for conn in self._conns]
+        return self.obs
 
     def close(self) -> None:
         for conn in self._conns:

@@ -804,8 +804,12 @@ class ESEpochLoop(RLEpochLoop):
         # per-member fitness is averaged across hosts — multi-host ES is
         # fitness variance reduction, not population scale-out.
         epoch_rng = self._split_rng()
-        perturb_rng, noise_rng, eval_gate_rng, eval_rng = jax.random.split(
-            epoch_rng, 4)
+        perturb_rng, eval_gate_rng = jax.random.split(epoch_rng)
+        # action-noise rng is COLLECT randomness (per-process, like env
+        # seeds): hosts must explore independently for the cross-host
+        # fitness average to reduce variance. Only perturb/gate draws come
+        # from the shared stream (they feed the update / guard a branch)
+        noise_rng = self._split_collect_rng()
         stacked, eps = self.learner.perturb(self.state.params, perturb_rng)
         fitness = self.learner.evaluate_population(
             stacked, self.vec_env, window=self.rollout_length,
@@ -836,9 +840,13 @@ class ESEpochLoop(RLEpochLoop):
                 < self.es_cfg.eval_prob):
             metrics["eval_fitness_mean"] = self.learner.evaluate_mean_params(
                 self.state.params, self.vec_env,
-                window=self.rollout_length, rng=eval_rng)
+                window=self.rollout_length)
             eval_env_steps = self.rollout_length * self.num_envs
-            self.vec_env.drain_completed_episodes()  # not training episodes
+            # drop the eval window's own episodes AND the part-eval partial
+            # episodes still in flight: a fresh restart is the only way
+            # mean-policy steps can't straddle into next epoch's stats
+            self.vec_env.drain_completed_episodes()
+            self.vec_env.restart_episodes()
 
         self.epoch_counter += 1
         env_steps = self.rollout_length * self.num_envs

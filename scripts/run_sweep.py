@@ -16,8 +16,8 @@ Sweep YAML schema::
     program: test_heuristic_from_config.py   # entry, relative to scripts/
     config_path: ramp_job_partitioning_configs   # passed through
     config_name: heuristic_config
-    method: grid            # grid | random
-    num_runs: 8             # random only
+    method: grid            # grid | random | bayes
+    num_runs: 8             # random/bayes: total run budget
     max_parallel: 4
     stagger_seconds: 1.0
     path_to_save: /tmp/ddls_tpu/sweeps
@@ -27,10 +27,14 @@ Sweep YAML schema::
       eval_loop.actor._target_:
         values: [ddls_tpu.envs.baselines.AcceptableJCT,
                  ddls_tpu.envs.baselines.SiPML]
-      algo.lr:              # random method: distributions
+      algo.lr:              # random/bayes methods: distributions
         distribution: log_uniform
         min: 1.0e-6
         max: 1.0e-3
+    # bayes only (reference surface: wandb_sweep_config.yaml method: bayes)
+    metric: episode_return  # objective column from the analysis summary
+    goal: maximise          # maximise | minimise
+    num_initial: 4          # random warm-start runs before the GP drives
 """
 from __future__ import annotations
 
@@ -93,6 +97,94 @@ def expand_parameter_space(parameters: Dict[str, Dict[str, Any]],
     raise ValueError(f"unknown sweep method {method!r}")
 
 
+# --------------------------------------------------- bayes (GP-EI) search
+def _param_codec(parameters: Dict[str, Dict[str, Any]]):
+    """Per-parameter decoders from the unit cube to the spec space.
+
+    Replaces the reference's W&B ``method: bayes`` service
+    (wandb_sweep_config.yaml; run_wandb_sweep.py spawns agents against it)
+    with an in-repo sequential GP: continuous params map linearly (or
+    log-linearly), ints round, categoricals bucket the unit interval.
+    """
+    keys = sorted(parameters)
+    decoders = []
+    for key in keys:
+        spec = parameters[key]
+        dist = spec.get("distribution", "choice")
+        if "values" in spec or dist == "choice":
+            values = list(spec["values"])
+            decoders.append(
+                lambda u, v=values: v[min(int(u * len(v)), len(v) - 1)])
+        elif dist == "uniform":
+            lo, hi = float(spec["min"]), float(spec["max"])
+            decoders.append(lambda u, lo=lo, hi=hi: lo + u * (hi - lo))
+        elif dist == "log_uniform":
+            lo, hi = np.log(spec["min"]), np.log(spec["max"])
+            decoders.append(
+                lambda u, lo=lo, hi=hi: float(np.exp(lo + u * (hi - lo))))
+        elif dist == "int_uniform":
+            import math
+
+            lo, hi = int(spec["min"]), int(spec["max"])
+            # floor, not int(): truncation-toward-zero would skew negative
+            # ranges (min unreachable, max overweighted)
+            decoders.append(
+                lambda u, lo=lo, hi=hi:
+                min(math.floor(lo + u * (hi - lo + 1)), hi))
+        else:
+            raise ValueError(f"unknown distribution {dist!r} for {key!r}")
+    return keys, decoders
+
+
+def _decode_point(u: np.ndarray, keys, decoders) -> Dict[str, Any]:
+    return {k: dec(float(x)) for k, x, dec in zip(keys, u, decoders)}
+
+
+def gp_ei_propose(X, y, n_dims: int, rng: np.random.Generator,
+                  n_candidates: int = 512,
+                  length_scale: float = 0.25) -> np.ndarray:
+    """Next point in [0,1]^d maximising expected improvement under an RBF
+    Gaussian-process posterior fit to (X, y); y is maximised."""
+    import math
+
+    X = np.asarray(X, np.float64)
+    y = np.asarray(y, np.float64)
+    y_mu, y_sd = y.mean(), y.std()
+    z = (y - y_mu) / (y_sd + 1e-12)
+
+    def rbf(A, B):
+        d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / length_scale**2)
+
+    K = rbf(X, X) + (1e-4 + 1e-8) * np.eye(len(X))
+    L = np.linalg.cholesky(K)
+    alpha = np.linalg.solve(L.T, np.linalg.solve(L, z))
+
+    cand = rng.uniform(size=(n_candidates, n_dims))
+    Ks = rbf(cand, X)                       # [C, N]
+    mu = Ks @ alpha
+    v = np.linalg.solve(L, Ks.T)            # [N, C]
+    var = np.maximum(1.0 - (v**2).sum(0), 1e-12)
+    sd = np.sqrt(var)
+
+    best = z.max()
+    zz = (mu - best) / sd
+    phi = np.exp(-0.5 * zz**2) / math.sqrt(2 * math.pi)
+    Phi = 0.5 * (1.0 + np.vectorize(math.erf)(zz / math.sqrt(2)))
+    ei = (mu - best) * Phi + sd * phi
+    return cand[int(np.argmax(ei))]
+
+
+def _run_objective(run_dir: str, metric: str) -> float:
+    """Pull the finished run's objective from the analysis summary (the
+    same table the aggregation step writes)."""
+    from ddls_tpu.analysis import load_run
+    from ddls_tpu.analysis.loaders import summary_table
+
+    row = summary_table([load_run(run_dir)]).iloc[0]
+    return float(row[metric])
+
+
 def _short_label(assignment: Dict[str, Any]) -> str:
     parts = []
     for key, val in assignment.items():
@@ -105,10 +197,105 @@ def _short_label(assignment: Dict[str, Any]) -> str:
 
 
 # ------------------------------------------------------------------ execution
+def _start_run(sweep_cfg: Dict[str, Any], sweep_dir: Path, index: int,
+               assignment: Dict[str, Any], program: str,
+               fixed: List[str]) -> Dict[str, Any]:
+    """Launch one sweep run as a subprocess; returns its record."""
+    run_dir = sweep_dir / f"run_{index}"
+    run_dir.mkdir(parents=True, exist_ok=True)
+    with open(run_dir / "sweep_params.yaml", "w") as f:
+        yaml.safe_dump(assignment, f)
+
+    overrides = fixed + [f"{k}={v}" for k, v in assignment.items()]
+    overrides += [f"experiment.path_to_save={run_dir}"]
+    cmd = [sys.executable, program]
+    if sweep_cfg.get("config_path"):
+        cmd += ["--config-path",
+                os.path.join(SCRIPTS_DIR, sweep_cfg["config_path"])]
+    if sweep_cfg.get("config_name"):
+        cmd += ["--config-name", sweep_cfg["config_name"]]
+    cmd += overrides
+
+    log = open(run_dir / "stdout.log", "w")
+    proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
+                            cwd=SCRIPTS_DIR)
+    return {"index": index, "label": _short_label(assignment),
+            "dir": str(run_dir), "assignment": assignment,
+            "proc": proc, "log": log, "returncode": None,
+            "started": time.time()}
+
+
+def _run_bayes_sweep(sweep_cfg: Dict[str, Any], sweep_dir: Path,
+                     verbose: bool = True) -> List[Dict[str, Any]]:
+    """Sequential GP-EI search: random warm-start runs, then each next
+    assignment maximises expected improvement on the observed objectives.
+    Runs execute one at a time (the GP needs the previous result before
+    proposing; ``max_parallel`` does not apply)."""
+    parameters = sweep_cfg.get("parameters", {})
+    keys, decoders = _param_codec(parameters)
+    n_dims = len(keys)
+    num_runs = int(sweep_cfg.get("num_runs", 8))
+    num_initial = int(sweep_cfg.get(
+        "num_initial", max(3, min(2 * n_dims, num_runs - 1))))
+    metric = sweep_cfg.get("metric", "episode_return")
+    goal = str(sweep_cfg.get("goal", "maximise")).lower()
+    sign = -1.0 if goal.startswith("min") else 1.0
+    rng = np.random.default_rng(int(sweep_cfg.get("seed", 0)))
+    program = os.path.join(SCRIPTS_DIR, sweep_cfg["program"])
+    run_timeout = float(sweep_cfg.get("run_timeout_seconds", 3600))
+    fixed = list(sweep_cfg.get("overrides") or [])
+
+    X: List[np.ndarray] = []
+    y: List[float] = []
+    records: List[Dict[str, Any]] = []
+    for i in range(num_runs):
+        if i < num_initial or len(y) < 2:
+            u = rng.uniform(size=n_dims)
+            source = "random-init"
+        else:
+            u = gp_ei_propose(np.stack(X), np.asarray(y), n_dims, rng)
+            source = "gp-ei"
+        assignment = _decode_point(u, keys, decoders)
+        rec = _start_run(sweep_cfg, sweep_dir, i, assignment, program, fixed)
+        rec["proposal_source"] = source
+        if verbose:
+            print(f"[sweep] bayes run_{i} ({source}): {rec['label']}",
+                  flush=True)
+        try:
+            rec["returncode"] = rec["proc"].wait(timeout=run_timeout)
+        except subprocess.TimeoutExpired:
+            rec["proc"].kill()
+            rec["proc"].wait()
+            rec["returncode"] = "timeout"
+        rec["log"].close()
+        if rec["returncode"] == 0:
+            try:
+                obj = _run_objective(rec["dir"], metric)
+                rec["objective"] = obj
+                if np.isfinite(obj):
+                    X.append(u)
+                    y.append(sign * obj)
+            except Exception as exc:  # failed runs just don't teach the GP
+                print(f"[sweep] run_{i}: objective unavailable ({exc})")
+        records.append(rec)
+    with open(sweep_dir / "bayes_history.yaml", "w") as f:
+        yaml.safe_dump([{k: v for k, v in r.items()
+                         if k in ("index", "label", "assignment",
+                                  "proposal_source", "objective",
+                                  "returncode")}
+                        for r in records], f, sort_keys=False)
+    for rec in records:
+        rec.pop("proc", None)
+        rec.pop("log", None)
+    return records
+
+
 def run_sweep(sweep_cfg: Dict[str, Any],
               sweep_dir: Path,
               verbose: bool = True) -> List[Dict[str, Any]]:
     """Launch all runs of the sweep; returns per-run records."""
+    if sweep_cfg.get("method") == "bayes":
+        return _run_bayes_sweep(sweep_cfg, sweep_dir, verbose)
     assignments = expand_parameter_space(
         sweep_cfg.get("parameters", {}),
         method=sweep_cfg.get("method", "grid"),
@@ -142,29 +329,9 @@ def run_sweep(sweep_cfg: Dict[str, Any],
                 time.sleep(0.2)
 
     for i, assignment in enumerate(assignments):
-        run_dir = sweep_dir / f"run_{i}"
-        run_dir.mkdir(parents=True, exist_ok=True)
-        with open(run_dir / "sweep_params.yaml", "w") as f:
-            yaml.safe_dump(assignment, f)
-
-        overrides = fixed + [f"{k}={v}" for k, v in assignment.items()]
-        overrides += [f"experiment.path_to_save={run_dir}"]
-        cmd = [sys.executable, program]
-        if sweep_cfg.get("config_path"):
-            cmd += ["--config-path",
-                    os.path.join(SCRIPTS_DIR, sweep_cfg["config_path"])]
-        if sweep_cfg.get("config_name"):
-            cmd += ["--config-name", sweep_cfg["config_name"]]
-        cmd += overrides
-
         _reap(block=False)
-        log = open(run_dir / "stdout.log", "w")
-        proc = subprocess.Popen(cmd, stdout=log, stderr=subprocess.STDOUT,
-                                cwd=SCRIPTS_DIR)
-        rec = {"index": i, "label": _short_label(assignment),
-               "dir": str(run_dir), "assignment": assignment,
-               "proc": proc, "log": log, "returncode": None,
-               "started": time.time()}
+        rec = _start_run(sweep_cfg, sweep_dir, i, assignment, program,
+                         fixed)
         records.append(rec)
         running.append(rec)
         if verbose:

@@ -45,6 +45,105 @@ def test_grid_requires_values():
             method="grid")
 
 
+def test_bayes_codec_roundtrip():
+    space = {
+        "lr": {"distribution": "log_uniform", "min": 1e-6, "max": 1e-3},
+        "gamma": {"values": [0.99, 0.999]},
+        "layers": {"distribution": "int_uniform", "min": 1, "max": 3},
+        "frac": {"distribution": "uniform", "min": 0.25, "max": 0.75},
+    }
+    keys, decoders = run_sweep_mod._param_codec(space)
+    assert keys == sorted(space)
+    rng = np.random.default_rng(0)
+    for _ in range(64):
+        a = run_sweep_mod._decode_point(rng.uniform(size=4), keys, decoders)
+        assert 1e-6 <= a["lr"] <= 1e-3
+        assert a["gamma"] in (0.99, 0.999)
+        assert a["layers"] in (1, 2, 3)
+        assert 0.25 <= a["frac"] <= 0.75
+    # unit-interval endpoints decode to the space's endpoints, not beyond
+    lo = run_sweep_mod._decode_point(np.zeros(4), keys, decoders)
+    hi = run_sweep_mod._decode_point(np.ones(4) - 1e-9, keys, decoders)
+    assert lo["layers"] == 1 and hi["layers"] == 3
+    assert lo["gamma"] == 0.99 and hi["gamma"] == 0.999
+    # negative int ranges stay uniform (floor, not truncate-toward-zero)
+    nkeys, ndecs = run_sweep_mod._param_codec(
+        {"n": {"distribution": "int_uniform", "min": -3, "max": -1}})
+    vals = [run_sweep_mod._decode_point(np.array([u]), nkeys, ndecs)["n"]
+            for u in np.linspace(0, 0.999, 300)]
+    counts = {v: vals.count(v) for v in (-3, -2, -1)}
+    assert all(80 <= c <= 120 for c in counts.values()), counts
+
+
+def test_gp_ei_concentrates_near_optimum():
+    """On a smooth 1-D objective the GP-EI proposer's queries must
+    outperform random search: after a random warm start, proposals should
+    cluster near the optimum."""
+    rng = np.random.default_rng(1)
+
+    def objective(u):  # max at u = 0.3
+        return -(u - 0.3) ** 2
+
+    X = [np.array([u]) for u in rng.uniform(size=4)]
+    y = [objective(x[0]) for x in X]
+    proposals = []
+    for _ in range(10):
+        u = run_sweep_mod.gp_ei_propose(np.stack(X), np.asarray(y), 1, rng)
+        proposals.append(float(u[0]))
+        X.append(u)
+        y.append(objective(u[0]))
+    # the last proposals should be near the optimum
+    tail = proposals[-4:]
+    assert max(abs(u - 0.3) for u in tail) < 0.1, (proposals, tail)
+    best = X[int(np.argmax(y))][0]
+    assert abs(best - 0.3) < 0.05
+
+
+def test_bayes_sweep_end_to_end(tmp_path):
+    """A real (tiny) bayes sweep: heuristic episodes whose return depends
+    monotonically on the swept max-JCT fraction; the GP must find a
+    near-top assignment and the history file must record proposal
+    sources."""
+    sweep_cfg = {
+        "name": "bayes_sweep",
+        "program": "test_heuristic_from_config.py",
+        "config_path": "ramp_job_partitioning_configs",
+        "config_name": "heuristic_config",
+        "method": "bayes",
+        "num_runs": 5,
+        "num_initial": 2,
+        "metric": "episode_return",
+        "goal": "maximise",
+        "seed": 0,
+        "run_timeout_seconds": 240,
+        "overrides": [
+            "experiment.seed=0",
+            "eval_loop.env.jobs_config.replication_factor=2",
+            "eval_loop.env.jobs_config.job_sampling_mode=remove",
+            "eval_loop.env.jobs_config.synthetic.n_cnn=1",
+            "eval_loop.env.jobs_config.synthetic.n_translation=1",
+            "eval_loop.env.jobs_config.job_interarrival_time_dist.val=100",
+        ],
+        "parameters": {
+            ("eval_loop.env.jobs_config."
+             "max_acceptable_job_completion_time_frac_dist.min_val"): {
+                "distribution": "uniform", "min": 0.05, "max": 0.9},
+        },
+    }
+    cfg_path = tmp_path / "sweep.yaml"
+    cfg_path.write_text(yaml.safe_dump(sweep_cfg))
+    out = tmp_path / "out"
+    rc = run_sweep_mod.main(["--sweep-config", str(cfg_path),
+                             "--out", str(out)])
+    assert rc == 0
+    history = yaml.safe_load((out / "bayes_history.yaml").read_text())
+    assert len(history) == 5
+    assert history[0]["proposal_source"] == "random-init"
+    assert any(h["proposal_source"] == "gp-ei" for h in history)
+    assert all("objective" in h for h in history)
+    assert (out / "sweep_summary.csv").exists()
+
+
 def test_heuristic_sweep_end_to_end(tmp_path):
     """A real 4-actor sweep over a shrunken episode produces per-run
     results and a sweep comparison table."""
