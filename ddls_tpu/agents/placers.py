@@ -233,6 +233,10 @@ class FirstFitDepPlacer:
         from ddls_tpu.sim.actions import DepPlacement
 
         topo = cluster.topology
+        dense = topo.dense_tables()
+        if dense["pair_channel"] is not None:
+            return self._get_arrays(op_partition, op_placement, cluster,
+                                    dense)
         placements = op_placement.action
         result: Dict[int, Dict[Tuple[str, str], tuple]] = {}
         channels_used_by_other_jobs: Set[str] = set()
@@ -307,6 +311,47 @@ class FirstFitDepPlacer:
                         for ch_num in chosen:
                             channels_used_by_other_jobs.update(by_ch[ch_num])
         return DepPlacement(result)
+
+    def _get_arrays(self, op_partition, op_placement, cluster, dense):
+        """Array fast path (single-channel complete topology): every flow
+        dep's channel is the direct (src, dst) link, so placement is one
+        vectorised gather + occupancy check per job — same outcome as the
+        first-fit scan (there is exactly one path and one channel to try),
+        at none of the per-dep dict cost."""
+        from ddls_tpu.sim.actions import DepArrays, DepPlacement
+
+        pair_channel = dense["pair_channel"]
+        occ = cluster.channel_occ
+        placements = op_placement.action
+        action: Dict[int, DepArrays] = {}
+        # channels claimed by earlier jobs of this same composite action
+        taken = None
+        for job_id, partitioned in op_partition.partitioned_jobs.items():
+            if job_id not in placements:
+                continue
+            job_idx = partitioned.details["job_idx"]
+            sc = op_placement.job_server_codes[job_id]
+            arrays = partitioned.graph.finalize()
+            src_code = sc[arrays["edge_src"]]
+            dst_code = sc[arrays["edge_dst"]]
+            is_flow = (arrays["edge_size"] > 0) & (src_code != dst_code)
+            chan = np.full(arrays["edge_src"].shape[0], -1, np.int32)
+            flow_idx = np.nonzero(is_flow)[0]
+            chan[flow_idx] = pair_channel[src_code[flow_idx],
+                                          dst_code[flow_idx]]
+            channels = np.unique(chan[flow_idx])
+            occ_vals = occ[channels]
+            ok = bool(((occ_vals == -1) | (occ_vals == job_idx)).all())
+            if ok and taken is not None:
+                ok = not bool(taken[channels].any())
+            if not ok:
+                continue  # a busy channel drops the whole job (reference
+                # first_fit_dep_placer.py: one failed flow blocks the job)
+            action[job_id] = DepArrays(arrays["edge_ids"], chan, channels)
+            if taken is None:
+                taken = np.zeros(occ.shape[0], bool)
+            taken[channels] = True
+        return DepPlacement(action, channel_ids=dense["channel_ids"])
 
     def _valid_path_channels(self, topo, src_node: str, dst_node: str,
                              job_idx: int,

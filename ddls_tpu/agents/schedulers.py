@@ -43,10 +43,13 @@ class SRPTDepScheduler:
         pass
 
     def get(self, op_partition, dep_placement, cluster):
-        from ddls_tpu.sim.actions import DepSchedule
+        from ddls_tpu.sim.actions import DepArrays, DepSchedule
 
         if not dep_placement.action:
             return DepSchedule({})
+        if any(isinstance(v, DepArrays)
+               for v in dep_placement.action.values()):
+            return self._get_arrays(op_partition, dep_placement)
         # global SRPT ordering over all newly placed flow deps, priced by the
         # comm model (reference sorts all jobdeps together,
         # srpt_dep_scheduler.py:66-77). Costs come straight from the priced
@@ -103,3 +106,34 @@ class SRPTDepScheduler:
                     action[ch_id][job_id][dep_id] = priority
             offset += len(deps)
         return DepSchedule({k: dict(v) for k, v in action.items()})
+
+    def _get_arrays(self, op_partition, dep_placement):
+        """Array fast path: the same global stable argsort over the priced
+        arrays (per-job edge order, jobs in action order — the identical
+        tie classes as the dict path), with priorities written straight
+        into each job's DepArrays payload instead of per-channel dicts."""
+        from ddls_tpu.sim.actions import DepSchedule
+
+        jobs = list(dep_placement.action)
+        costs_list = []
+        for job_id in jobs:
+            job = op_partition.partitioned_jobs[job_id]
+            arr = job.dep_init_run_time_arr
+            if arr is None:
+                payload = dep_placement.action[job_id]
+                arr = np.array([job.dep_init_run_time.get(d, 0.0)
+                                for d in payload.edge_ids], np.float64)
+            costs_list.append(arr)
+        all_costs = (np.concatenate(costs_list) if len(costs_list) > 1
+                     else costs_list[0])
+        order = np.argsort(-all_costs, kind="stable")
+        pri = np.empty(len(order), np.int64)
+        pri[order] = np.arange(len(order))
+        offset = 0
+        schedule_action: dict = {"__arrays__": {}}
+        for job_id, costs in zip(jobs, costs_list):
+            payload = dep_placement.action[job_id]
+            payload.pri = pri[offset:offset + len(costs)]
+            schedule_action["__arrays__"][job_id] = payload
+            offset += len(costs)
+        return DepSchedule(schedule_action)

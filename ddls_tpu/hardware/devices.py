@@ -48,6 +48,26 @@ class Processor:
         self.mounted_job_id[job_idx] = job.job_id
         self.memory_occupied += mem
 
+    def mount_ops(self, job, op_ids) -> None:
+        """Mount many ops of one job at once: a single memory check over
+        the summed costs (equivalent to per-op sequential checks, since
+        costs are non-negative) and one set update."""
+        job_idx = job.details["job_idx"]
+        mem = sum(job.graph.memory_cost(op_id) for op_id in op_ids)
+        mounted = self.mounted_job_idx_to_ops.get(job_idx)
+        if mounted is not None and not mounted.isdisjoint(op_ids):
+            raise RuntimeError(
+                f"worker {self.processor_id}: op(s) of job {job.job_id} "
+                "already mounted")
+        if self.memory_occupied + mem > self.memory_capacity:
+            raise MemoryError(
+                f"worker {self.processor_id}: ops of job {job.job_id} need "
+                f"{mem} B but only "
+                f"{self.memory_capacity - self.memory_occupied} B free")
+        self.mounted_job_idx_to_ops.setdefault(job_idx, set()).update(op_ids)
+        self.mounted_job_id[job_idx] = job.job_id
+        self.memory_occupied += mem
+
     def unmount(self, job, op_id: str) -> None:
         job_idx = job.details["job_idx"]
         if op_id not in self.mounted_job_idx_to_ops.get(job_idx, ()):

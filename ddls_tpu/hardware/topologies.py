@@ -46,6 +46,48 @@ class BaseTopology:
     def num_servers(self) -> int:
         return len(self.server_ids)
 
+    def dense_tables(self):
+        """Dense-integer runtime tables for the array-native step pipeline.
+
+        Built lazily, once: server ids -> 0..S-1, channel ids -> 0..K-1
+        (topology insertion order), and — when every server pair is
+        directly connected with exactly one channel per direction (the
+        canonical RAMP shape) — a [S, S] matrix mapping a directed server
+        pair to its dense channel index. ``pair_channel`` is None for
+        multi-channel or non-complete topologies; callers fall back to the
+        dict/path pipeline there.
+        """
+        tables = getattr(self, "_dense_tables", None)
+        if tables is not None:
+            return tables
+        import numpy as np
+
+        server_index = {sid: i for i, sid in enumerate(self.server_ids)}
+        channel_ids = list(self.channel_id_to_channel)
+        channel_index = {cid: i for i, cid in enumerate(channel_ids)}
+        S = len(self.server_ids)
+        pair_channel = None
+        if (getattr(self, "num_channels", 0) == 1
+                and len(channel_ids) == S * (S - 1)):
+            pair_channel = np.full((S, S), -1, np.int32)
+            complete = True
+            for cid, ch in self.channel_id_to_channel.items():
+                u = server_index.get(ch.src)
+                v = server_index.get(ch.dst)
+                if u is None or v is None:
+                    complete = False
+                    break
+                pair_channel[u, v] = channel_index[cid]
+            if not complete or (pair_channel < 0).sum() != S:  # diag only
+                pair_channel = None
+        self._dense_tables = {
+            "server_index": server_index,
+            "channel_ids": channel_ids,
+            "channel_index": channel_index,
+            "pair_channel": pair_channel,
+        }
+        return self._dense_tables
+
     def _add_bidirectional_channels(self, u: str, v: str, num_channels: int,
                                     bandwidth: float) -> None:
         self.links.append((u, v))

@@ -142,12 +142,15 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
         while True:
             cmd, payload = conn.recv()
             if cmd == "reset":
-                if payload is not None:
-                    seed = payload
-                else:
-                    # seedless reset = restart: advance to a fresh workload
-                    # rather than replaying the abandoned episode's seed
-                    seed += seed_stride
+                # seedless reset replays the current seed (same semantics
+                # as the serial VectorEnv); "restart" advances it
+                seed = payload if payload is not None else seed
+                obs = env.reset(seed=seed)
+                episode_return, episode_length = 0.0, 0
+                conn.send(("obs", obs))
+            elif cmd == "restart":
+                # abandon the in-progress episode for a fresh workload
+                seed += seed_stride
                 obs = env.reset(seed=seed)
                 episode_return, episode_length = 0.0, 0
                 conn.send(("obs", obs))
@@ -248,11 +251,11 @@ class ParallelVectorEnv:
 
     def restart_episodes(self) -> List[Dict[str, np.ndarray]]:
         """See VectorEnv.restart_episodes: workers advance their own seeds
-        on a seedless reset and drop partial episode accumulators."""
+        on the dedicated restart command and drop partial accumulators."""
         if self._first_reset:
             return self.reset()
         for conn in self._conns:
-            conn.send(("reset", None))
+            conn.send(("restart", None))
         self.obs = [self._recv(conn)[1] for conn in self._conns]
         return self.obs
 

@@ -16,7 +16,7 @@ sub-action dropped a job (the blocking cause)
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from ddls_tpu.demands.job import Job
 from ddls_tpu.graphs.readers import backward_op_id
@@ -123,6 +123,9 @@ class OpPlacement:
         self.job_ids: Set[int] = set(self.action)
         self.worker_to_ops: Dict[str, List[dict]] = defaultdict(list)
         self.job_id_to_worker_ids: Dict[int, Set[str]] = defaultdict(set)
+        # job_id -> per-op dense server codes (cluster server-table order),
+        # stashed by the pricing pass for the array dep pipeline
+        self.job_server_codes: Dict[int, Any] = {}
         for job_id, op_to_worker in self.action.items():
             for op_id, worker_id in op_to_worker.items():
                 self.worker_to_ops[worker_id].append(
@@ -142,6 +145,45 @@ class OpSchedule:
             self.job_ids.update(self.action[worker_id].keys())
 
 
+class DepArrays:
+    """Array-native dep placement/schedule for one job (the fast path on
+    dense single-channel complete topologies — the canonical RAMP shape).
+
+    ``chan[i]`` is the dense channel index carrying dep i (-1 = non-flow),
+    aligned with ``graph.finalize()['edge_ids']``; ``channels`` the unique
+    dense channels the job rides; ``pri`` the SRPT priorities (filled by
+    the scheduler). One payload replaces the per-dep dict chain
+    placer -> DepPlacement views -> schedule dicts -> channel mounts
+    (docs/round3_notes.md item 2: "dep placement -> schedule -> mount over
+    int arrays, Python dict mirrors as lazy views")."""
+
+    __slots__ = ("edge_ids", "chan", "channels", "pri")
+
+    def __init__(self, edge_ids, chan, channels, pri=None):
+        self.edge_ids = edge_ids
+        self.chan = chan
+        self.channels = channels
+        self.pri = pri
+
+    def to_dep_dict(self, channel_ids) -> Dict[EdgeId, tuple]:
+        """Materialise the dict view (dep -> channel-id tuple) for legacy
+        readers; ``channel_ids`` maps dense index -> string channel id."""
+        out: Dict[EdgeId, tuple] = {}
+        cache: Dict[int, tuple] = {}
+        for dep_id, c in zip(self.edge_ids, self.chan.tolist()):
+            if c < 0:
+                out[dep_id] = _NONFLOW_VIEW
+            else:
+                view = cache.get(c)
+                if view is None:
+                    view = cache.setdefault(c, (channel_ids[c],))
+                out[dep_id] = view
+        return out
+
+
+_NONFLOW_VIEW = (None,)
+
+
 class DepPlacement:
     """job -> dep -> channel-id tuple (or any iterable); a None entry means
     not a flow (reference: actions/dep_placement.py:6).
@@ -149,15 +191,27 @@ class DepPlacement:
     The placer hands many deps the *same* channel tuple (all deps of one
     server pair ride the same channels), so the real-channel views are
     deduplicated per distinct tuple and shared — they are read-only
-    downstream."""
+    downstream. On the array fast path the per-job value is a
+    ``DepArrays`` payload instead of a dict, and the dict views are
+    materialised lazily (``jobdep_to_channels`` property) only if a legacy
+    reader asks."""
 
-    def __init__(self, action: Dict[int, Dict[EdgeId, tuple]]):
+    def __init__(self, action: Dict[int, Dict[EdgeId, tuple]],
+                 channel_ids: Optional[List[str]] = None):
         self.action = action
         self.job_ids: Set[int] = set(self.action)
-        self.jobdep_to_channels: Dict[Tuple[int, EdgeId],
-                                      frozenset] = {}
+        self._channel_ids = channel_ids  # dense -> string id (arrays path)
+        self._jobdep_to_channels: Optional[Dict] = None
+        if not any(isinstance(v, DepArrays) for v in action.values()):
+            self._build_views()
+
+    def _build_views(self) -> None:
+        self._jobdep_to_channels = {}
         views: Dict[int, frozenset] = {}
         for job_id, dep_to_channels in self.action.items():
+            if isinstance(dep_to_channels, DepArrays):
+                dep_to_channels = dep_to_channels.to_dep_dict(
+                    self._channel_ids)
             for dep_id, channels in dep_to_channels.items():
                 key = id(channels)
                 real = views.get(key)
@@ -165,7 +219,13 @@ class DepPlacement:
                     real = frozenset(
                         c for c in channels if c is not None)
                     views[key] = real
-                self.jobdep_to_channels[(job_id, dep_id)] = real
+                self._jobdep_to_channels[(job_id, dep_id)] = real
+
+    @property
+    def jobdep_to_channels(self) -> Dict[Tuple[int, EdgeId], frozenset]:
+        if self._jobdep_to_channels is None:
+            self._build_views()
+        return self._jobdep_to_channels
 
 
 class DepSchedule:
@@ -416,6 +476,10 @@ def assign_dep_run_times(cluster, op_partition: OpPartition,
         sc_list = [code[worker_to_server[placement[op]]]
                    for op in arrays["op_ids"]]
         sc = np.asarray(sc_list, np.int64)
+        # dense per-op server codes double as the array dep-pipeline's
+        # src/dst lookup (cluster server-table order == topology dense
+        # order); stashing here saves the placer a per-op dict walk
+        op_placement.job_server_codes[job_id] = sc
 
         times = np.zeros(partitioned.graph.n_deps, np.float64)
         extra_e, extra_u, extra_v = [], [], []

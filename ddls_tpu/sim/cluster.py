@@ -39,7 +39,7 @@ import math
 import pathlib
 import threading
 from collections import defaultdict
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -134,6 +134,12 @@ class RampClusterEnvironment:
         # values are shared frozensets (one per distinct channel tuple of a
         # dep placement) assigned wholesale in _place_deps — never mutated
         self.job_dep_to_channels: Dict[int, Dict[EdgeId, frozenset]] = {}
+        # array dep pipeline (dense single-channel complete topologies):
+        # per-channel occupancy (-1 free, else job_idx) + per-job DepArrays
+        # payloads; the dict mirrors above stay empty on this path
+        self.channel_occ = np.full(
+            len(self.topology.channel_id_to_channel), -1, np.int32)
+        self.job_dep_arrays: Dict[int, Any] = {}
         self.job_id_to_job_idx: Dict[int, int] = {}
         self.job_idx_to_job_id: Dict[int, int] = {}
         self.job_op_placement: Dict[int, Dict[str, str]] = {}
@@ -246,10 +252,6 @@ class RampClusterEnvironment:
         workers_with_job = [
             w for w in self.topology.workers.values()
             if job_idx in w.mounted_job_idx_to_ops]
-        # channels holding this job's deps
-        channels_with_job = [
-            ch for ch in self.topology.channel_id_to_channel.values()
-            if job_idx in ch.mounted_job_idx_to_deps]
 
         # precompute static per-tick structures (flow-ness, sorted op lists
         # per worker with op indices, per-channel sorted dep indices) --
@@ -269,13 +271,36 @@ class RampClusterEnvironment:
             worker_op_lists.append(
                 [(state.op_index[op_id], pri_map.get(op_id, 0))
                  for op_id in sorted(w.mounted_job_idx_to_ops[job_idx])])
-        channel_dep_lists = []
-        for ch in channels_with_job:
-            pri_map = ch.dep_priority.get(job_idx, {})
-            channel_dep_lists.append(
-                (ch.channel_id,
-                 [(state.edge_index[dep], pri_map.get(dep, 0))
-                  for dep in sorted(ch.mounted_job_idx_to_deps[job_idx])]))
+        payload = self.job_dep_arrays.get(job_idx)
+        if payload is not None:
+            # array pipeline: group flow deps per dense channel, each group
+            # in sorted-edge-id order (edge_sorted_rank), priorities from
+            # the payload — the same lists the dict path builds, read off
+            # arrays. SRPT priorities are globally unique, so within- and
+            # across-channel ordering can't change any tick outcome.
+            rank = graph.finalize()["edge_sorted_rank"]
+            chan = payload.chan
+            pri_arr = (payload.pri if payload.pri is not None
+                       else np.zeros(chan.shape[0], np.int64))
+            flow_i = np.nonzero(chan >= 0)[0]
+            order = flow_i[np.argsort(rank[flow_i], kind="stable")]
+            by_ch: Dict[int, list] = {}
+            chan_l = chan.tolist()
+            pri_l = pri_arr.tolist()
+            for i in order.tolist():
+                by_ch.setdefault(chan_l[i], []).append((i, pri_l[i]))
+            channel_dep_lists = list(by_ch.items())
+        else:
+            channels_with_job = [
+                ch for ch in self.topology.channel_id_to_channel.values()
+                if job_idx in ch.mounted_job_idx_to_deps]
+            channel_dep_lists = []
+            for ch in channels_with_job:
+                pri_map = ch.dep_priority.get(job_idx, {})
+                channel_dep_lists.append(
+                    (ch.channel_id,
+                     [(state.edge_index[dep], pri_map.get(dep, 0))
+                      for dep in sorted(ch.mounted_job_idx_to_deps[job_idx])]))
 
         t = comm_oh = comp_oh = busy = 0.0
         guard = 0
@@ -620,7 +645,11 @@ class RampClusterEnvironment:
         for job_id, op_to_worker in op_placement.action.items():
             job = self.job_queue.jobs[job_id]
             job_idx = job.details["job_idx"]
+            by_worker: Dict[str, list] = {}
             for op_id, worker_id in op_to_worker.items():
+                by_worker.setdefault(worker_id, []).append(op_id)
+            mounted_workers = job.details["mounted_workers"]
+            for worker_id, op_ids in by_worker.items():
                 worker = self.topology.workers[worker_id]
                 # RAMP rule 1: at most one job per worker
                 if any(idx != job_idx
@@ -630,10 +659,10 @@ class RampClusterEnvironment:
                         f"holds job idx(s) "
                         f"{set(worker.mounted_job_idx_to_ops) - {job_idx}}, "
                         f"cannot mount job idx {job_idx}")
-                worker.mount(job, op_id)
-                job.details["mounted_workers"].add(worker_id)
-                self.job_op_to_worker.setdefault(job_idx, {})[op_id] = \
-                    worker_id
+                worker.mount_ops(job, op_ids)
+                mounted_workers.add(worker_id)
+            self.job_op_to_worker.setdefault(job_idx, {}).update(
+                op_to_worker)
             self._register_running_job(job)
             self.job_op_placement[job_id] = dict(op_to_worker)
 
@@ -674,7 +703,33 @@ class RampClusterEnvironment:
                 worker.op_priority.setdefault(job_idx, {}).update(op_to_pri)
 
     def _place_deps(self, dep_placement) -> None:
+        from ddls_tpu.sim.actions import DepArrays
+
+        if any(isinstance(v, DepArrays)
+               for v in dep_placement.action.values()):
+            for job_id, payload in dep_placement.action.items():
+                job_idx = self.job_id_to_job_idx[job_id]
+                job = self.jobs_running[job_idx]
+                occ_vals = self.channel_occ[payload.channels]
+                bad = (occ_vals != -1) & (occ_vals != job_idx)
+                if bad.any():
+                    # RAMP rule 2: at most one job per channel
+                    raise RuntimeError(
+                        f"RAMP rule violation: channels "
+                        f"{payload.channels[bad][:8].tolist()} already hold "
+                        f"other job idxs "
+                        f"{self.channel_occ[payload.channels[bad]][:8].tolist()}")
+                self.channel_occ[payload.channels] = job_idx
+                self.job_dep_arrays[job_idx] = payload
+                job.details["mounted_channels"].update(
+                    payload.channels.tolist())
+                self.job_dep_placement[job_id] = payload
+            return
         channel_lookup = self.topology.channel_id_to_channel
+        # keep channel_occ the single occupancy truth on dense topologies
+        # even when a dict-style placement mounts (e.g. hand-crafted test
+        # actions): the array placer reads only channel_occ for validity
+        chan_index = self.topology.dense_tables()["channel_index"]
         jobdep_views = dep_placement.jobdep_to_channels
         for job_id, dep_to_channels in dep_placement.action.items():
             job_idx = self.job_id_to_job_idx[job_id]
@@ -706,11 +761,19 @@ class RampClusterEnvironment:
                 channel.mounted_job_idx_to_deps.setdefault(
                     job_idx, set()).update(deps)
                 mounted_channels.add(ch_id)
+                ci = chan_index.get(ch_id)
+                if ci is not None:
+                    self.channel_occ[ci] = job_idx
             self.job_dep_placement[job_id] = dep_to_channels
 
     def _schedule_deps(self, dep_schedule) -> None:
         for ch_id, job_to_deps in dep_schedule.action.items():
             if ch_id is None:
+                continue
+            if ch_id == "__arrays__":
+                # array pipeline: priorities already live inside each job's
+                # DepArrays payload (written by the scheduler, mounted by
+                # _place_deps); nothing to copy into channel dicts
                 continue
             channel = self.topology.channel_id_to_channel[ch_id]
             for job_id, dep_to_pri in job_to_deps.items():
@@ -730,10 +793,17 @@ class RampClusterEnvironment:
             workers = self.topology.workers
             for worker_id in job.details["mounted_workers"]:
                 workers[worker_id].unmount_job(job)
-        if self.job_dep_to_channels.pop(job_idx, None) is not None:
+        payload = self.job_dep_arrays.pop(job_idx, None)
+        if payload is not None:
+            self.channel_occ[payload.channels] = -1
+        elif self.job_dep_to_channels.pop(job_idx, None) is not None:
             channel_lookup = self.topology.channel_id_to_channel
+            chan_index = self.topology.dense_tables()["channel_index"]
             for ch_id in job.details["mounted_channels"]:
                 channel_lookup[ch_id].unmount_job(job_idx)
+                ci = chan_index.get(ch_id)
+                if ci is not None:
+                    self.channel_occ[ci] = -1
         self.job_op_placement.pop(job.job_id, None)
         self.job_dep_placement.pop(job.job_id, None)
 

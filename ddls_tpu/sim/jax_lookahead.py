@@ -117,6 +117,18 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
     chan_dense: Dict[str, int] = {}
     dep_sorted_rank = {e: r for r, e in enumerate(sorted(graph.edge_ids))}
     worker_to_server = topo.worker_to_server
+    # array pipeline: channel/priority reads come off the DepArrays
+    # payload (the channel dicts stay empty on that path)
+    payload = getattr(cluster, "job_dep_arrays", {}).get(job_idx)
+    if payload is not None:
+        chan_l = payload.chan.tolist()
+        pri_l = (payload.pri.tolist() if payload.pri is not None
+                 else [0] * len(chan_l))
+        edge_chan = {e: ((c,) if c >= 0 else ())
+                     for e, c in zip(payload.edge_ids, chan_l)}
+        edge_pri = dict(zip(payload.edge_ids, pri_l))
+    else:
+        edge_chan = edge_pri = None
     for edge in graph.edge_ids:
         ei = arrays["edge_index"][edge]
         u, v = edge
@@ -129,8 +141,11 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
                    and worker_to_server[src_w] != worker_to_server[dst_w])
         dep_is_flow[ei] = is_flow
         if is_flow:
-            channels = sorted(cluster.job_dep_to_channels.get(
-                job_idx, {}).get(edge, ()))
+            if edge_chan is not None:
+                channels = edge_chan.get(edge, ())
+            else:
+                channels = sorted(cluster.job_dep_to_channels.get(
+                    job_idx, {}).get(edge, ()))
             if len(channels) > pad_links:
                 raise ValueError(
                     f"dep {edge} rides {len(channels)} channels > pad_links "
@@ -138,10 +153,13 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
             for li, ch_id in enumerate(channels):
                 dep_channel[ei, li] = chan_dense.setdefault(
                     ch_id, len(chan_dense))
-            ch = (topo.channel_id_to_channel[channels[0]]
-                  if channels else None)
-            pri = (ch.dep_priority.get(job_idx, {}).get(edge, 0)
-                   if ch is not None else 0)
+            if edge_pri is not None:
+                pri = edge_pri.get(edge, 0) if channels else 0
+            else:
+                ch = (topo.channel_id_to_channel[channels[0]]
+                      if channels else None)
+                pri = (ch.dep_priority.get(job_idx, {}).get(edge, 0)
+                       if ch is not None else 0)
         else:
             pri = 0
         dep_score[ei] = pri * (m + 1) + (m - dep_sorted_rank[edge])
@@ -209,34 +227,51 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
     # channels + priorities: flow deps only
     dep_pri = np.zeros(m, np.float64)
     edge_ids = arrays["edge_ids"]
-    chan_dense: Dict[str, int] = {}
-    dep_to_channels = cluster.job_dep_to_channels.get(job_idx, {})
-    channel_id_to_channel = topo.channel_id_to_channel
     flow_idx = np.nonzero(dep_is_flow)[0]
-    flow_channels = []
-    links = 1
-    for ei in flow_idx:
-        edge = edge_ids[ei]
-        channels = sorted(dep_to_channels.get(edge, ()))
-        dense = []
-        for ch_id in channels:
-            ci = chan_dense.get(ch_id)
-            if ci is None:
-                ci = chan_dense.setdefault(ch_id, len(chan_dense))
-            dense.append(ci)
-        flow_channels.append(dense)
-        if len(dense) > links:
-            links = len(dense)
-        if channels:
-            pri = channel_id_to_channel[channels[0]].dep_priority.get(
-                job_idx, {}).get(edge, 0)
-            if pri:
-                dep_pri[ei] = pri
+    payload = getattr(cluster, "job_dep_arrays", {}).get(job_idx)
+    if payload is not None:
+        # array pipeline: channels/priorities straight off the DepArrays
+        # payload; per-job local channel renumbering is one searchsorted
+        # (numbering order is irrelevant — channels only partition deps).
+        # pri=None (placement without a schedule) degrades to priority 0
+        # exactly like the host engine's zeros fallback
+        pri_src = (payload.pri if payload.pri is not None
+                   else np.zeros(m, np.int64))
+        dep_pri[flow_idx] = pri_src[flow_idx].astype(np.float64)
+        uniq = np.unique(payload.chan[flow_idx])
+        n_chan = len(uniq)
+        dep_channel = np.full((m, 1), -1, np.int32)
+        dep_channel[flow_idx, 0] = np.searchsorted(
+            uniq, payload.chan[flow_idx]).astype(np.int32)
+    else:
+        chan_dense: Dict[str, int] = {}
+        dep_to_channels = cluster.job_dep_to_channels.get(job_idx, {})
+        channel_id_to_channel = topo.channel_id_to_channel
+        flow_channels = []
+        links = 1
+        for ei in flow_idx:
+            edge = edge_ids[ei]
+            channels = sorted(dep_to_channels.get(edge, ()))
+            dense = []
+            for ch_id in channels:
+                ci = chan_dense.get(ch_id)
+                if ci is None:
+                    ci = chan_dense.setdefault(ch_id, len(chan_dense))
+                dense.append(ci)
+            flow_channels.append(dense)
+            if len(dense) > links:
+                links = len(dense)
+            if channels:
+                pri = channel_id_to_channel[channels[0]].dep_priority.get(
+                    job_idx, {}).get(edge, 0)
+                if pri:
+                    dep_pri[ei] = pri
+        n_chan = len(chan_dense)
+        dep_channel = np.full((m, links), -1, np.int32)
+        for ei, dense in zip(flow_idx, flow_channels):
+            dep_channel[ei, :len(dense)] = dense
 
     dep_score = dep_pri * (m + 1) + (m - arrays["edge_sorted_rank"])
-    dep_channel = np.full((m, links), -1, np.int32)
-    for ei, dense in zip(flow_idx, flow_channels):
-        dep_channel[ei, :len(dense)] = dense
 
     return LookaheadArrays(
         op_remaining=arrays["compute"], op_valid=np.ones(n, bool),
@@ -247,7 +282,7 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
         dep_mutual=arrays["edge_mutual"], dep_is_flow=dep_is_flow,
         dep_score=dep_score, dep_channel=dep_channel,
         num_workers=max(len(worker_dense), 1),
-        num_channels=max(len(chan_dense), 1))
+        num_channels=max(n_chan, 1))
 
 
 def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,

@@ -253,6 +253,13 @@ class RLEpochLoop:
         self.mesh = make_mesh(n_devices)
         self.apply_fn = lambda p, o: batched_policy_apply(self.model, p, o)
         self._build_learner()
+        # warm-start / mid-training resume (the reference has no Launcher
+        # resume — SURVEY §5.4; here any saved train state can seed a new
+        # run, e.g. fine-tuning the best checkpoint at a lower lr)
+        if kwargs.get("initial_checkpoint_path"):
+            self.load_agent_checkpoint(kwargs["initial_checkpoint_path"])
+            print(f"Warm-started train state from "
+                  f"{kwargs['initial_checkpoint_path']}")
 
         self._rng = jax.random.PRNGKey(self.seed + 1)
         # offset keeps the collect stream distinct from the update stream
