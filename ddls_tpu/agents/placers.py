@@ -332,13 +332,11 @@ class FirstFitDepPlacer:
             job_idx = partitioned.details["job_idx"]
             sc = op_placement.job_server_codes[job_id]
             arrays = partitioned.graph.finalize()
-            src_code = sc[arrays["edge_src"]]
-            dst_code = sc[arrays["edge_dst"]]
-            is_flow = (arrays["edge_size"] > 0) & (src_code != dst_code)
+            is_flow = partitioned.graph.flow_mask_from_codes(sc)
             chan = np.full(arrays["edge_src"].shape[0], -1, np.int32)
             flow_idx = np.nonzero(is_flow)[0]
-            chan[flow_idx] = pair_channel[src_code[flow_idx],
-                                          dst_code[flow_idx]]
+            chan[flow_idx] = pair_channel[sc[arrays["edge_src"][flow_idx]],
+                                          sc[arrays["edge_dst"][flow_idx]]]
             channels = np.unique(chan[flow_idx])
             occ_vals = occ[channels]
             ok = bool(((occ_vals == -1) | (occ_vals == job_idx)).all())

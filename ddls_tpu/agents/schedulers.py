@@ -38,6 +38,19 @@ class SRPTOpScheduler:
         return OpSchedule({k: dict(v) for k, v in action.items()})
 
 
+def _srpt_priorities(costs_list):
+    """Global SRPT priorities over concatenated per-job cost arrays: one
+    stable descending argsort, so every tie class (per-job edge order,
+    jobs in action order) resolves identically wherever this is used —
+    the single ranking shared by the dict and array scheduler paths."""
+    all_costs = (np.concatenate(costs_list) if len(costs_list) > 1
+                 else costs_list[0])
+    order = np.argsort(-all_costs, kind="stable")
+    pri = np.empty(len(order), np.int64)
+    pri[order] = np.arange(len(order))
+    return pri
+
+
 class SRPTDepScheduler:
     def __init__(self, **kwargs):
         pass
@@ -82,11 +95,7 @@ class SRPTDepScheduler:
             jobs.append(job_id)
             deps_lists.append(deps)
             costs_list.append(costs)
-        all_costs = (np.concatenate(costs_list) if len(costs_list) > 1
-                     else costs_list[0])
-        order = np.argsort(-all_costs, kind="stable")
-        pri = np.empty(len(order), np.int64)
-        pri[order] = np.arange(len(order))
+        pri = _srpt_priorities(costs_list)
 
         action: Dict[str, Dict[int, Dict[tuple, int]]] = defaultdict(
             lambda: defaultdict(dict))
@@ -124,11 +133,7 @@ class SRPTDepScheduler:
                 arr = np.array([job.dep_init_run_time.get(d, 0.0)
                                 for d in payload.edge_ids], np.float64)
             costs_list.append(arr)
-        all_costs = (np.concatenate(costs_list) if len(costs_list) > 1
-                     else costs_list[0])
-        order = np.argsort(-all_costs, kind="stable")
-        pri = np.empty(len(order), np.int64)
-        pri[order] = np.arange(len(order))
+        pri = _srpt_priorities(costs_list)
         offset = 0
         schedule_action: dict = {"__arrays__": {}}
         for job_id, costs in zip(jobs, costs_list):

@@ -115,9 +115,48 @@ class AcceptableJCT(BaselineActor):
         return int(action)
 
 
+class OracleJCT(AcceptableJCT):
+    """AcceptableJCT upgraded with TRUE lookahead prices: pick the smallest
+    partition degree whose priced lookahead JCT (communication included)
+    meets the job's max-acceptable JCT, freeing the most workers for later
+    arrivals. Falls back to the AcceptableJCT approximation when the env
+    doesn't carry candidate prices (candidate_pricing off).
+
+    Consumes the batched candidate pricing the jax-lookahead go/no-go
+    scoped (docs/jax_lookahead_gonogo.md point 2): all candidate degrees
+    priced per decision, one vmapped dispatch on an accelerator. No
+    reference counterpart — the reference's heuristics never see real
+    lookahead outcomes."""
+
+    name = "oracle_jct"
+
+    def compute_action(self, obs, job_to_place=None, env=None,
+                       **kwargs) -> int:
+        prices = getattr(env, "candidate_prices", None) if env else None
+        if not prices:
+            return super().compute_action(obs, job_to_place=job_to_place,
+                                          **kwargs)
+        valid = [a for a in _valid_actions(obs) if a != 0]
+        if not valid or job_to_place is None:
+            return super().compute_action(obs, job_to_place=job_to_place,
+                                          **kwargs)
+        limit = job_to_place.max_acceptable_jct
+        acceptable = [a for a in valid
+                      if prices.get(a) is not None and prices[a][0] <= limit]
+        if acceptable:
+            return int(min(acceptable))
+        # no candidate meets the SLA: the job blocks regardless, so take
+        # the smallest-JCT placeable candidate (max throughput salvage)
+        placeable = [a for a in valid if prices.get(a) is not None]
+        if placeable:
+            return int(min(placeable, key=lambda a: prices[a][0]))
+        return int(valid[0])
+
+
 BASELINE_ACTORS = {
     cls.name: cls for cls in (RandomActor, NoParallelism, MinParallelism,
-                              MaxParallelism, SiPML, AcceptableJCT)
+                              MaxParallelism, SiPML, AcceptableJCT,
+                              OracleJCT)
 }
 
 

@@ -67,6 +67,7 @@ class RampJobPartitioningEnvironment:
                  use_jax_lookahead: bool = False,
                  use_native_lookahead: str | bool = "auto",
                  apply_action_mask: bool = True,
+                 candidate_pricing: Optional[str] = None,
                  **kwargs):
         self.topology_config = topology_config
         self.node_config = node_config
@@ -76,6 +77,18 @@ class RampJobPartitioningEnvironment:
             else float(max_simulation_run_time))
         self.job_queue_capacity = job_queue_capacity
         self.apply_action_mask = apply_action_mask
+        # opt-in all-candidate lookahead pricing at each decision point
+        # (None | "native" | "jax" | "auto"): prices every valid partition
+        # degree of the queued job, exposes them as env.candidate_prices /
+        # info["candidate_prices"], and prefetches the lookahead memo so
+        # the chosen action's cluster.step lookahead is a cache hit. The
+        # jax backend batches all candidates into ONE vmapped dispatch
+        # (f32 — results carry f32 rounding into the memo cache, same
+        # trade as use_jax_lookahead); "auto" uses jax only on a real
+        # accelerator and the bit-exact C++ engine otherwise
+        # (docs/jax_lookahead_gonogo.md point 2).
+        self.candidate_pricing = candidate_pricing
+        self.candidate_prices: dict = {}
         self.name = name
 
         self.cluster = RampClusterEnvironment(
@@ -130,6 +143,7 @@ class RampJobPartitioningEnvironment:
         self.reward_function.reset(env=self)
         self.information_function.reset(self)
         self.obs = self._get_observation()
+        self._price_candidates()
         return self.obs
 
     def _is_done(self) -> bool:
@@ -148,6 +162,22 @@ class RampJobPartitioningEnvironment:
         formula (reference: :331-343)."""
         return build_partition_action(job.graph, self.min_op_run_time_quantum,
                                       max_partitions)
+
+    def _price_candidates(self) -> None:
+        self.candidate_prices = {}
+        if self.candidate_pricing:
+            from ddls_tpu.sim.candidate_pricing import price_candidate_degrees
+
+            self.candidate_prices = price_candidate_degrees(
+                self, backend=self.candidate_pricing)
+
+    def price_candidate_degrees(self, degrees=None, backend="auto"):
+        """Lookahead prices for candidate partition degrees of the queued
+        job (see ddls_tpu.sim.candidate_pricing)."""
+        from ddls_tpu.sim.candidate_pricing import price_candidate_degrees
+
+        return price_candidate_degrees(self, degrees=degrees,
+                                       backend=backend)
 
     def step(self, action: int, verbose: bool = False):
         self.cluster_step_stats = {}
@@ -215,7 +245,13 @@ class RampJobPartitioningEnvironment:
         self.done = self._is_done()
         if not self.done:
             self.obs = self._get_observation()
+            self._price_candidates()
+        else:
+            # no next decision: stale prices must not leak into terminal info
+            self.candidate_prices = {}
         self.info = self.information_function.extract(env=self,
                                                       done=self.done)
+        if self.candidate_prices:
+            self.info["candidate_prices"] = self.candidate_prices
         self.step_counter += 1
         return self.obs, self.reward, self.done, self.info

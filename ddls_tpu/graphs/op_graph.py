@@ -263,9 +263,17 @@ class OpGraph:
             if si is None:
                 si = server_dense.setdefault(s, len(server_dense))
             scode[i] = si
-        is_flow = ((arrays["edge_size"] > 0)
-                   & (scode[arrays["edge_src"]] != scode[arrays["edge_dst"]]))
-        return scode, is_flow
+        return scode, self.flow_mask_from_codes(scode)
+
+    def flow_mask_from_codes(self, scode) -> np.ndarray:
+        """Per-dep flow mask from an already-dense per-op server-code array
+        (any consistent labelling): THE flow predicate — nonzero size AND
+        endpoints on different servers. Every array-path caller (dep
+        placer, candidate pricing, packers, register-time zeroing) must go
+        through here so the engines can never disagree on flow-ness."""
+        arrays = self.finalize()
+        return ((arrays["edge_size"] > 0)
+                & (scode[arrays["edge_src"]] != scode[arrays["edge_dst"]]))
 
     def _bfs_depths(self, root: Optional[str], op_index: Dict[str, int], n: int) -> np.ndarray:
         """Shortest-path node counts from the first source op; 0 if unreachable

@@ -424,9 +424,18 @@ class RampClusterEnvironment:
         job_idx = job.details["job_idx"]
         split = tuple(sorted(
             self.op_partition.job_id_to_split_forward_ops[job_id].items()))
+        return self.lookahead_key_for(job, split,
+                                      self.job_op_to_worker[job_idx])
+
+    @staticmethod
+    def lookahead_key_for(job: Job, split: tuple,
+                          op_to_worker: Dict[str, str]) -> tuple:
+        """The exact lookahead memo key from explicit placement inputs —
+        shared by the mounted path (_lookahead_cache_key) and candidate
+        pricing (which keys an UNMOUNTED hypothetical placement so the
+        eventual real placement hits the same entry)."""
         worker_to_group: Dict[str, int] = {}
         groups = []
-        op_to_worker = self.job_op_to_worker[job_idx]
         for op in job.graph.op_ids:
             w = op_to_worker[op]
             groups.append(worker_to_group.setdefault(w, len(worker_to_group)))
@@ -751,13 +760,17 @@ class RampClusterEnvironment:
             mounted_channels = job.details["mounted_channels"]
             for ch_id, deps in ch_to_deps.items():
                 channel = channel_lookup[ch_id]
-                # RAMP rule 2: at most one job per channel
-                if any(idx != job_idx
-                       for idx in channel.mounted_job_idx_to_deps):
+                # RAMP rule 2: at most one job per channel — checked
+                # against BOTH stores (an array-path job marks only
+                # channel_occ, a dict-path job only the channel dicts)
+                ci = chan_index.get(ch_id)
+                occ = (self.channel_occ[ci] if ci is not None else -1)
+                holders = (set(channel.mounted_job_idx_to_deps)
+                           | {int(occ)}) - {-1, job_idx}
+                if holders:
                     raise RuntimeError(
                         f"RAMP rule violation: channel {ch_id} already "
-                        f"holds job idx(s) "
-                        f"{set(channel.mounted_job_idx_to_deps) - {job_idx}}")
+                        f"holds job idx(s) {holders}")
                 channel.mounted_job_idx_to_deps.setdefault(
                     job_idx, set()).update(deps)
                 mounted_channels.add(ch_id)

@@ -66,11 +66,14 @@ class LookaheadArrays:
 
 
 def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
-                           pad_links: int = 1) -> LookaheadArrays:
+                           pad_links: int = 1,
+                           context: dict | None = None) -> LookaheadArrays:
     """Assemble padded arrays for a job already mounted on the cluster
     (the same inputs the host engine reads). f32: feeds the jitted engine
     (the C++ engine has its own exact-size f64 packer,
-    :func:`build_native_lookahead_arrays`)."""
+    :func:`build_native_lookahead_arrays`). ``context`` as in
+    :func:`build_native_lookahead_arrays` (candidate pricing of unmounted
+    placements)."""
     job_idx = job.details["job_idx"]
     graph = job.graph
     arrays = graph.finalize()
@@ -79,7 +82,8 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
         raise ValueError(f"job needs ({n},{m}) > padding ({pad_ops},{pad_deps})")
 
     topo = cluster.topology
-    op_to_worker = cluster.job_op_to_worker[job_idx]
+    op_to_worker = (context["op_to_worker"] if context is not None
+                    else cluster.job_op_to_worker[job_idx])
     # dense per-job worker renumbering (only workers holding this job matter)
     worker_ids = sorted({op_to_worker[op] for op in graph.op_ids})
     worker_dense = {w: i for i, w in enumerate(worker_ids)}
@@ -95,11 +99,15 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
 
     # host tie-break: first op in sorted-id order among priority maxes
     sorted_rank = {op: r for r, op in enumerate(sorted(graph.op_ids))}
+    ctx_op_pri = context.get("op_pri") if context is not None else None
     for op_id in graph.op_ids:
         i = arrays["op_index"][op_id]
         w = op_to_worker[op_id]
         op_worker[i] = worker_dense[w]
-        pri = topo.workers[w].op_priority.get(job_idx, {}).get(op_id, 0)
+        if ctx_op_pri is not None:
+            pri = ctx_op_pri.get(op_id, 0)
+        else:
+            pri = topo.workers[w].op_priority.get(job_idx, {}).get(op_id, 0)
         op_score[i] = pri * (n + 1) + (n - sorted_rank[op_id])
 
     dep_remaining = np.zeros(pad_deps, np.float32)
@@ -119,7 +127,8 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
     worker_to_server = topo.worker_to_server
     # array pipeline: channel/priority reads come off the DepArrays
     # payload (the channel dicts stay empty on that path)
-    payload = getattr(cluster, "job_dep_arrays", {}).get(job_idx)
+    payload = (context.get("payload") if context is not None
+               else getattr(cluster, "job_dep_arrays", {}).get(job_idx))
     if payload is not None:
         chan_l = payload.chan.tolist()
         pri_l = (payload.pri.tolist() if payload.pri is not None
@@ -174,7 +183,9 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
         num_channels=max(len(chan_dense), 1))
 
 
-def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
+def build_native_lookahead_arrays(cluster, job,
+                                  context: dict | None = None
+                                  ) -> LookaheadArrays:
     """Exact-size f64 packing for the C++ engine (ddls_tpu/native).
 
     Produces the same arrays as :func:`build_lookahead_arrays` (same score
@@ -182,6 +193,11 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
     loops left are one O(n_ops) pass for worker/priority lookups and one
     pass over *flow* deps for channel lists — the O(n_deps) per-edge dict
     walk is replaced by index arithmetic on ``graph.finalize()`` arrays.
+
+    ``context`` supplies placement state for a job NOT mounted on the
+    cluster (candidate pricing): {"op_to_worker": {op: worker_id},
+    "op_pri": {op: pri}, "payload": DepArrays}. Without it, state is read
+    from the cluster's mounted structures.
     """
     job_idx = job.details["job_idx"]
     graph = job.graph
@@ -189,7 +205,12 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
     n, m = graph.n_ops, graph.n_deps
     topo = cluster.topology
     op_ids = arrays["op_ids"]
-    op_to_worker = cluster.job_op_to_worker[job_idx]
+    if context is not None:
+        op_to_worker = context["op_to_worker"]
+        ctx_op_pri = context.get("op_pri") or {}
+    else:
+        op_to_worker = cluster.job_op_to_worker[job_idx]
+        ctx_op_pri = None
     worker_to_server = topo.worker_to_server
     workers = topo.workers
 
@@ -203,7 +224,8 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
         wi = worker_dense.get(w)
         if wi is None:
             wi = worker_dense.setdefault(w, len(worker_dense))
-            pri_maps[w] = workers[w].op_priority.get(job_idx, {})
+            pri_maps[w] = (ctx_op_pri if ctx_op_pri is not None
+                           else workers[w].op_priority.get(job_idx, {}))
         op_worker[i] = wi
         server_of_op.append(worker_to_server[w])
         pri = pri_maps[w].get(op_id, 0)
@@ -228,7 +250,8 @@ def build_native_lookahead_arrays(cluster, job) -> LookaheadArrays:
     dep_pri = np.zeros(m, np.float64)
     edge_ids = arrays["edge_ids"]
     flow_idx = np.nonzero(dep_is_flow)[0]
-    payload = getattr(cluster, "job_dep_arrays", {}).get(job_idx)
+    payload = (context.get("payload") if context is not None
+               else getattr(cluster, "job_dep_arrays", {}).get(job_idx))
     if payload is not None:
         # array pipeline: channels/priorities straight off the DepArrays
         # payload; per-job local channel renumbering is one searchsorted
@@ -415,7 +438,13 @@ def _lookahead_fn_cached(num_workers: int, num_channels: int):
 
 def batched_lookahead_fn(num_workers: int, num_channels: int):
     """vmapped+jitted lookahead over a batch of padded jobs (leading batch
-    axis on every array input)."""
+    axis on every array input). Memoised per static (workers, channels)
+    pair — a fresh jax.jit object would recompile on every call."""
+    return _batched_lookahead_fn_cached(num_workers, num_channels)
+
+
+@_lru_cache(maxsize=None)
+def _batched_lookahead_fn_cached(num_workers: int, num_channels: int):
     import jax
     from functools import partial
 

@@ -914,7 +914,8 @@ class EvalLoop:
             queue = getattr(self.env.cluster, "job_queue", None)
             if queue is not None and len(queue.jobs):
                 job = list(queue.jobs.values())[0]
-            action = self.actor.compute_action(obs, job_to_place=job)
+            action = self.actor.compute_action(obs, job_to_place=job,
+                                               env=self.env)
             obs, reward, done, _ = self.env.step(action)
             total_reward += reward
             steps += 1

@@ -209,7 +209,7 @@ class TestEpochLoop:
     def test_dqn_config_translation(self):
         from ddls_tpu.train import dqn_config_from_rllib
 
-        cfg = dqn_config_from_rllib({
+        base = {
             "gamma": 0.999, "lr": 4.121e-7, "n_step": 3,
             "train_batch_size": 512, "target_network_update_freq": 100000,
             "replay_buffer_config": {"capacity": 100000,
@@ -217,12 +217,16 @@ class TestEpochLoop:
                                      "learning_starts": 10000},
             "exploration_config": {"final_epsilon": 0.05,
                                    "epsilon_timesteps": 1000000},
-            "max_requests_in_flight_per_sampler_worker": 2,  # ray-only
-        })
+        }
+        cfg = dqn_config_from_rllib(base)
         assert cfg.gamma == 0.999
         assert cfg.lr == 4.121e-7
         assert cfg.buffer_capacity == 100000
         assert cfg.prioritized_replay_alpha == 0.9
+        # ray-only plumbing keys are rejected loudly, never silently no-oped
+        with pytest.raises(ValueError, match="not consumed"):
+            dqn_config_from_rllib(
+                dict(base, max_requests_in_flight_per_sampler_worker=2))
         assert cfg.final_epsilon == 0.05
 
 
