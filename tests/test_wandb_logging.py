@@ -1,0 +1,100 @@
+"""W&B logging paths, exercised with a fake wandb module (the real one is
+optional and not installed in this image): the epoch loop's flattening
+logger (reference counterpart: rllib_epoch_loop.py:105-230 W&B results
+flattening) and the heuristic EvalLoop's episode metrics."""
+import tempfile
+
+import numpy as np
+import pytest
+
+
+class FakeWandb:
+    def __init__(self):
+        self.logged = []
+
+    def log(self, payload):
+        assert isinstance(payload, dict)
+        self.logged.append(payload)
+
+
+@pytest.fixture(scope="module")
+def dataset_dir():
+    from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+
+    d = tempfile.mkdtemp(prefix="wandb_log_")
+    generate_pipedream_txt_files(d, n_cnn=1, n_translation=1, seed=2)
+    return d
+
+
+def _env_config(dataset_dir):
+    return dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 100.0},
+            "replication_factor": 4,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 2},
+        max_partitions_per_op=4,
+        reward_function="job_acceptance",
+        max_simulation_run_time=5e4,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+
+
+def test_epoch_loop_flattens_results_to_wandb(dataset_dir):
+    from ddls_tpu.train import make_epoch_loop
+
+    fake = FakeWandb()
+    loop = make_epoch_loop(
+        "ppo",
+        path_to_env_cls=("ddls_tpu.envs.partitioning_env."
+                         "RampJobPartitioningEnvironment"),
+        env_config=_env_config(dataset_dir),
+        model={"fcnet_hiddens": [8],
+               "custom_model_config": {"out_features_msg": 4,
+                                       "out_features_hidden": 8,
+                                       "out_features_node": 4,
+                                       "out_features_graph": 4}},
+        algo_config={"lr": 1e-3, "train_batch_size": 8, "num_sgd_iter": 2,
+                     "sgd_minibatch_size": 8},
+        num_envs=2, rollout_length=4, n_devices=2,
+        use_parallel_envs=False, evaluation_interval=None,
+        seed=0, wandb=fake)
+    results = loop.run()
+    loop.log(results)
+    loop.close()
+
+    assert len(fake.logged) == 1
+    flat = fake.logged[0]
+    # nested dicts flattened to slash paths; every value a python float
+    assert "learner/total_loss" in flat
+    assert "env_steps_this_iter" in flat
+    assert all(isinstance(v, float) for v in flat.values())
+    # non-scalar leaves (lists, strings) are dropped, not crashed on
+    assert not any(isinstance(v, (list, str)) for v in flat.values())
+
+
+def test_eval_loop_logs_episode_metrics_to_wandb(dataset_dir):
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.envs.baselines import MaxParallelism
+    from ddls_tpu.train.loops import EvalLoop
+
+    fake = FakeWandb()
+    loop = EvalLoop(env=RampJobPartitioningEnvironment(
+                        **_env_config(dataset_dir)),
+                    actor=MaxParallelism(), wandb=fake)
+    results = loop.run(seed=0, max_steps=6)
+    assert np.isfinite(results["episode_return"])
+    assert len(fake.logged) == 1
+    assert fake.logged[0]["eval/episode_return"] == pytest.approx(
+        results["episode_return"])
+    assert fake.logged[0]["eval/episode_length"] == results["episode_length"]
