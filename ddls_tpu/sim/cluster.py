@@ -140,6 +140,10 @@ class RampClusterEnvironment:
         self.channel_occ = np.full(
             len(self.topology.channel_id_to_channel), -1, np.int32)
         self.job_dep_arrays: Dict[int, Any] = {}
+        # per-op dense server codes per mounted job (stashed from the
+        # pricing pass): lets the lookahead memo key canonicalise the
+        # worker grouping with one vectorised pass instead of a dict walk
+        self.job_server_codes: Dict[int, Any] = {}
         self.job_id_to_job_idx: Dict[int, int] = {}
         self.job_idx_to_job_id: Dict[int, int] = {}
         self.job_op_placement: Dict[int, Dict[str, str]] = {}
@@ -424,6 +428,16 @@ class RampClusterEnvironment:
         job_idx = job.details["job_idx"]
         split = tuple(sorted(
             self.op_partition.job_id_to_split_forward_ops[job_id].items()))
+        sc = self.job_server_codes.get(job_idx)
+        if sc is not None and len(sc) == job.graph.n_ops:
+            # worker grouping == server grouping (1 worker/server): the
+            # canonical first-appearance renumbering of the code array,
+            # fully vectorised. Identical tuple to the dict walk.
+            _, first_idx, inv = np.unique(sc, return_index=True,
+                                          return_inverse=True)
+            rank = np.argsort(np.argsort(first_idx))
+            return self._assemble_lookahead_key(job, split,
+                                                tuple(rank[inv].tolist()))
         return self.lookahead_key_for(job, split,
                                       self.job_op_to_worker[job_idx])
 
@@ -439,6 +453,15 @@ class RampClusterEnvironment:
         for op in job.graph.op_ids:
             w = op_to_worker[op]
             groups.append(worker_to_group.setdefault(w, len(worker_to_group)))
+        return RampClusterEnvironment._assemble_lookahead_key(
+            job, split, tuple(groups))
+
+    @staticmethod
+    def _assemble_lookahead_key(job: Job, split: tuple,
+                                groups: tuple) -> tuple:
+        """Single assembly point for the memo key tuple: every key builder
+        (dict walk, vectorised code-array path, candidate pricing) must
+        come through here so the namespaces can never diverge."""
         # the placed per-dep times as raw bytes: equivalent to (and ~100x
         # cheaper than) a tuple of the same floats in edge order
         arr = getattr(job, "dep_init_run_time_arr", None)
@@ -447,7 +470,7 @@ class RampClusterEnvironment:
         else:
             dep_times = tuple(job.dep_init_run_time.get(e, 0.0)
                               for e in job.graph.edge_ids)
-        return (job.details["model"], split, tuple(groups), dep_times)
+        return (job.details["model"], split, groups, dep_times)
 
     def _perform_lookahead_job_completion_time(self, action) -> None:
         for job_id in sorted(action.job_ids):
@@ -672,6 +695,9 @@ class RampClusterEnvironment:
                 mounted_workers.add(worker_id)
             self.job_op_to_worker.setdefault(job_idx, {}).update(
                 op_to_worker)
+            sc = op_placement.job_server_codes.get(job_id)
+            if sc is not None:
+                self.job_server_codes[job_idx] = sc
             self._register_running_job(job)
             self.job_op_placement[job_id] = dict(op_to_worker)
 
@@ -806,6 +832,7 @@ class RampClusterEnvironment:
             workers = self.topology.workers
             for worker_id in job.details["mounted_workers"]:
                 workers[worker_id].unmount_job(job)
+        self.job_server_codes.pop(job_idx, None)
         payload = self.job_dep_arrays.pop(job_idx, None)
         if payload is not None:
             self.channel_occ[payload.channels] = -1

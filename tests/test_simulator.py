@@ -282,3 +282,71 @@ def test_memo_caches_persist_across_resets_same_workload(tmp_path):
                   seed=2)
     warm = episode_outcome(cluster)
     assert warm == cold
+
+
+def test_pricing_memo_hit_equals_fresh_pricing(dataset_dir):
+    """The whole-result pricing memo (partition-cache entry, keyed by the
+    per-op server-code bytes) must serve arrays identical to a fresh
+    pricing pass — CLAUDE.md's memo-exactness practice for the new cache.
+    Partition/pricing caches persist across resets, so episode 2 with the
+    same seed replays the same placements as memo HITS."""
+    cluster = _make_cluster()
+    cfg = _jobs_config(dataset_dir, steps=5)
+    cfg["replication_factor"] = 3
+    cfg["job_interarrival_time_dist"] = {
+        "_target_": "ddls_tpu.demands.distributions.Fixed", "val": 10.0}
+
+    def first_priced_times(seed):
+        cluster.reset(cfg, max_simulation_run_time=None, seed=seed)
+        cluster.step(_heuristic_action(cluster, max_parts=2))
+        job = next(iter(cluster.jobs_running.values()), None) or \
+            next(iter(cluster.jobs_completed.values()))
+        return np.array(job.dep_init_run_time_arr, copy=True)
+
+    fresh = first_priced_times(seed=0)  # cold: group walk runs
+    memos = [e.get("pricing") for e in cluster.partition_cache.values()
+             if e.get("pricing")]
+    assert memos, "pricing memo never populated"
+    assert all(arr.dtype == np.float64
+               for memo in memos for arr in memo.values())
+    n_entries = sum(len(m) for m in memos)
+
+    hit = first_priced_times(seed=0)  # same seed -> same placement -> hit
+    memos2 = [e.get("pricing") for e in cluster.partition_cache.values()
+              if e.get("pricing")]
+    assert sum(len(m) for m in memos2) == n_entries, (
+        "memo grew on a replayed placement: the hit path never fired")
+    np.testing.assert_array_equal(hit, fresh)
+
+
+def test_fast_lookahead_key_matches_dict_walk(dataset_dir):
+    """The vectorised code-array key path must produce byte-identical
+    tuples to lookahead_key_for's dict walk on real placements (the
+    candidate-pricing prefetch relies on exact equality)."""
+    cluster = _make_cluster()
+    cfg = _jobs_config(dataset_dir, steps=5)
+    cfg["replication_factor"] = 3
+    cfg["job_interarrival_time_dist"] = {
+        "_target_": "ddls_tpu.demands.distributions.Fixed", "val": 10.0}
+    cluster.reset(cfg, max_simulation_run_time=None, seed=0)
+    checked = 0
+    for max_parts in (1, 2, 4):
+        if not len(cluster.job_queue):
+            cluster.step(Action())
+        if cluster.is_done():
+            break
+        cluster.step(_heuristic_action(cluster, max_parts=max_parts))
+        for job_idx, job in list(cluster.jobs_running.items()):
+            job_id = cluster.job_idx_to_job_id[job_idx]
+            if job_id not in cluster.op_partition.job_id_to_split_forward_ops:
+                continue
+            split = tuple(sorted(cluster.op_partition
+                                 .job_id_to_split_forward_ops[job_id]
+                                 .items()))
+            fast = cluster._lookahead_cache_key(job, job_id)
+            slow = cluster.lookahead_key_for(
+                job, split, cluster.job_op_to_worker[job_idx])
+            assert fast == slow
+            assert cluster.job_server_codes.get(job_idx) is not None
+            checked += 1
+    assert checked >= 2
