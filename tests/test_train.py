@@ -38,12 +38,15 @@ def test_logger_sqlite_accumulates_across_flushes(tmp_path):
 def test_ppo_config_from_rllib_maps_keys():
     cfg = ppo_config_from_rllib({
         "lr": 1e-3, "gamma": 0.9, "lambda": 0.95, "clip_param": 0.3,
-        "train_batch_size": 128, "grad_clip": 2.0, "unknown_key": 1})
+        "train_batch_size": 128, "grad_clip": 2.0})
     assert cfg.lr == 1e-3
     assert cfg.gae_lambda == 0.95
     assert cfg.clip_param == 0.3
     assert cfg.train_batch_size == 128
     assert cfg.grad_clip == 2.0
+    # unknown keys are rejected loudly, never silently no-oped
+    with pytest.raises(ValueError, match="not consumed"):
+        ppo_config_from_rllib({"lr": 1e-3, "unknown_key": 1})
 
 
 class _CountingEpochLoop:
