@@ -1,0 +1,136 @@
+"""Jittable RAMP block search: the placement half of the HBM-resident
+rollout north star.
+
+The jax-lookahead go/no-go (docs/jax_lookahead_gonogo.md point 3) left
+device-resident rollouts gated on one blocker: the first-fit block search
+(`agents/block_search.py`), a sequential scan over shapes × origins with
+per-cell dict lookups. This module is the array formulation of its inner
+primitive: for a boolean free-server grid and a static list of candidate
+block shapes, find the SAME (shape, origin) the host's
+``first_fit_block`` returns — first valid in (shape order, then
+lexicographic origin) — as a jittable, vmappable computation.
+
+Design: a block of shape (dc, dr, ds) anchored at (i, j, k) is free iff
+every cell of the window is free; the valid-anchor mask for one shape is
+the AND of the grid rolled by every in-window offset (window volumes are
+tiny — ≤ the cluster size — and shapes are static, so the rolls unroll at
+trace time). First-fit order is recovered by ranking anchors
+lexicographically and taking the minimum rank over valid anchors of the
+first shape that has any. Regular (non-diagonal) blocks anchored inside
+the meta shape never actually wrap (span = meta - shape + 1 bounds the
+origin), matching ``enumerate_block``'s modulo arithmetic exactly; the
+reference's diagonal S == -1 layout stays host-side
+(`block_search.enumerate_block:61`).
+
+Scope note (honest go/no-go): this jits the *search primitive*. The full
+placer remains a per-op loop with parent-colocation preferences and
+occupancy updates between ops (`placers.allocate_job`); folding that loop
+into a `lax.scan` over ops is the remaining work, not a semantics
+question — each step is exactly this primitive plus a scatter into the
+free mask.
+"""
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+Coord = Tuple[int, int, int]
+
+
+def valid_anchor_mask(free, shape: Coord, meta_shape: Coord):
+    """Boolean [C, R, S] grid of anchors where a ``shape`` block fits
+    entirely on free servers, anchored inside ``meta_shape``."""
+    import jax.numpy as jnp
+
+    ok = free
+    for dc in range(shape[0]):
+        for dr in range(shape[1]):
+            for ds in range(shape[2]):
+                if dc == dr == ds == 0:
+                    continue
+                ok = ok & jnp.roll(free, shift=(-dc, -dr, -ds),
+                                   axis=(0, 1, 2))
+    C, R, S = free.shape
+    span = (meta_shape[0] - shape[0] + 1, meta_shape[1] - shape[1] + 1,
+            meta_shape[2] - shape[2] + 1)
+    ii, jj, kk = jnp.meshgrid(jnp.arange(C), jnp.arange(R), jnp.arange(S),
+                              indexing="ij")
+    in_span = (ii < max(span[0], 0)) & (jj < max(span[1], 0)) \
+        & (kk < max(span[2], 0))
+    return ok & in_span
+
+
+def first_fit_block_jax(free, shapes: Sequence[Coord], meta_shape: Coord):
+    """(shape_idx, i, j, k, found) of the host ``first_fit_block`` result.
+
+    ``free``: bool [C, R, S] (True = this server can host the op: no other
+    job AND enough memory — the caller folds the memory check in, exactly
+    like ``block_ok``'s per-server conjunction). ``shapes``/``meta_shape``
+    are static. Fully jittable and vmappable over a batch of grids.
+    """
+    import jax.numpy as jnp
+
+    C, R, S = free.shape
+    n_cells = C * R * S
+    big = n_cells + 1
+
+    best_shape = jnp.int32(-1)
+    best_rank = jnp.int32(big)
+    found_any = jnp.bool_(False)
+    ii, jj, kk = jnp.meshgrid(jnp.arange(C), jnp.arange(R), jnp.arange(S),
+                              indexing="ij")
+    lex_rank = (ii * (R * S) + jj * S + kk).astype(jnp.int32)
+
+    for si, shape in enumerate(shapes):
+        span_ok = (meta_shape[0] >= shape[0] and meta_shape[1] >= shape[1]
+                   and meta_shape[2] >= shape[2])
+        if not span_ok:
+            continue
+        mask = valid_anchor_mask(free, shape, meta_shape)
+        any_valid = mask.any()
+        rank = jnp.where(mask, lex_rank, big).min().astype(jnp.int32)
+        take = any_valid & ~found_any
+        best_shape = jnp.where(take, jnp.int32(si), best_shape)
+        best_rank = jnp.where(take, rank, best_rank)
+        found_any = found_any | any_valid
+
+    i = best_rank // (R * S)
+    j = (best_rank // S) % R
+    k = best_rank % S
+    return best_shape, i, j, k, found_any
+
+
+@lru_cache(maxsize=None)
+def jitted_first_fit(shapes: Tuple[Coord, ...], meta_shape: Coord):
+    """jit-compiled closure over the static shape list; vmap over grids
+    with ``jax.vmap`` for batched (multi-env) searches."""
+    import jax
+
+    return jax.jit(lambda free: first_fit_block_jax(free, shapes,
+                                                    meta_shape))
+
+
+def block_cells(shape: Coord, origin: Coord,
+                ramp_shape: Coord) -> List[Coord]:
+    """Servers covered by the found block — delegated to the host's
+    ``enumerate_block`` so the geometry can never diverge from it."""
+    from ddls_tpu.agents.block_search import enumerate_block
+
+    return enumerate_block(shape, ramp_shape, origin)
+
+
+def free_grid_from_ramp(ramp, ramp_shape: Coord, job_idx,
+                        op_size=None) -> np.ndarray:
+    """Fold ``block_ok``'s per-server conjunction into one boolean grid:
+    free of other jobs AND (when ``op_size`` given) enough memory."""
+    grid = np.zeros(ramp_shape, dtype=bool)
+    for coord, entry in ramp.items():
+        occupants = entry["job_idxs"]
+        # exactly block_ok's test: blocked iff occupied by OTHER jobs
+        ok = (not occupants) or (job_idx in occupants)
+        if ok and op_size is not None:
+            ok = entry["mem"] >= op_size
+        grid[coord] = ok
+    return grid

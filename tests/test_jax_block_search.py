@@ -1,0 +1,85 @@
+"""Jittable block search vs the host first-fit oracle: randomized
+equivalence over occupancy grids, shape lists, and meta shapes (the
+placement-side counterpart of the lookahead engine's parity fuzz)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddls_tpu.agents.block_search import (block_shapes_for, factor_pairs,
+                                          first_fit_block)
+from ddls_tpu.sim.jax_block_search import (block_cells,
+                                           first_fit_block_jax,
+                                           free_grid_from_ramp,
+                                           jitted_first_fit)
+
+
+def _random_ramp(rng, ramp_shape, occupancy_p, job_idx):
+    ramp = {}
+    for c in range(ramp_shape[0]):
+        for r in range(ramp_shape[1]):
+            for s in range(ramp_shape[2]):
+                occ = set()
+                if rng.rand() < occupancy_p:
+                    occ.add(int(rng.randint(0, 5)))
+                ramp[(c, r, s)] = {"mem": float(rng.rand() * 100),
+                                   "job_idxs": occ}
+    return ramp
+
+
+@pytest.mark.parametrize("ramp_shape", [(4, 4, 2), (2, 2, 2), (3, 2, 4)])
+def test_matches_host_first_fit_randomized(ramp_shape):
+    rng = np.random.RandomState(hash(ramp_shape) % 2**31)
+    job_idx = 1
+    for trial in range(60):
+        ramp = _random_ramp(rng, ramp_shape, rng.choice([0.2, 0.5, 0.8]),
+                            job_idx)
+        n = int(rng.choice([1, 2, 4, 8]))
+        shapes = [s for s in block_shapes_for(factor_pairs(n), ramp_shape)
+                  if -1 not in s]  # diagonal layout stays host-side
+        if not shapes:
+            continue
+        op_size = float(rng.rand() * 80) if rng.rand() < 0.5 else None
+
+        host = first_fit_block(shapes, ramp_shape, ramp_shape, ramp,
+                               job_idx, op_size=op_size)
+        free = free_grid_from_ramp(ramp, ramp_shape, job_idx,
+                                   op_size=op_size)
+        si, i, j, k, found = first_fit_block_jax(
+            jnp.asarray(free), tuple(shapes), ramp_shape)
+        if host is None:
+            assert not bool(found), (trial, shapes)
+            continue
+        assert bool(found), (trial, shapes)
+        cells = block_cells(shapes[int(si)], (int(i), int(j), int(k)),
+                            ramp_shape)
+        assert cells == host, (trial, shapes[int(si)],
+                               (int(i), int(j), int(k)), host)
+
+
+def test_jitted_and_vmapped_batch():
+    """One compiled search serves a batch of occupancy grids (the
+    multi-env use case for device-resident placement)."""
+    ramp_shape = (4, 4, 2)
+    shapes = tuple(s for s in block_shapes_for(factor_pairs(4), ramp_shape)
+                   if -1 not in s)
+    fn = jitted_first_fit(shapes, ramp_shape)
+    rng = np.random.RandomState(0)
+    grids = rng.rand(8, *ramp_shape) > 0.5
+    batched = jax.vmap(fn)(jnp.asarray(grids))
+    si, i, j, k, found = (np.asarray(x) for x in batched)
+    assert found.shape == (8,)
+    for b in range(8):
+        ramp = {(c, r, s): {"mem": 1.0,
+                            "job_idxs": set() if grids[b, c, r, s]
+                            else {9}}
+                for c in range(4) for r in range(4) for s in range(2)}
+        host = first_fit_block(list(shapes), ramp_shape, ramp_shape, ramp,
+                               1, op_size=None)
+        assert bool(found[b]) == (host is not None)
+        if host is not None:
+            cells = block_cells(shapes[int(si[b])],
+                                (int(i[b]), int(j[b]), int(k[b])),
+                                ramp_shape)
+            assert cells == host
