@@ -120,3 +120,30 @@ def test_all_shipped_env_configs_cap_edge_padding():
                 f"{cfg_path}: pad_obs_kwargs must set max_edges (the "
                 "fully-connected default is a ~20x perf trap)")
     assert checked >= 4, "expected to find padded env configs to check"
+
+
+def test_shipped_load32_configs_keep_binding_regime():
+    """docs/results_round3 hangs off env_load32's loaded regime; an edit
+    that quietly relaxes the load (longer interarrivals, fewer jobs, the
+    1e6 horizon) would turn the headline experiment's env back into the
+    ceiling regime where every policy ties. Pin the load parameters."""
+    import glob
+    import os
+
+    import yaml
+
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    paths = glob.glob(os.path.join(
+        root, "ramp_job_*_configs", "env_config", "env_load32.yaml"))
+    assert len(paths) == 2, paths  # partitioning + shaping trees
+    for path in sorted(paths):
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+        jobs = cfg["jobs_config"]
+        ia = jobs["job_interarrival_time_dist"]
+        assert float(ia["val"]) <= 120, (path, ia)
+        assert int(jobs["num_training_steps"]) == 20, path
+        assert int(jobs["replication_factor"]) == 60, path
+        assert float(cfg["max_simulation_run_time"]) == 2e4, path
+        assert cfg["node_config"]["type_1"]["num_nodes"] == 32, path
