@@ -1,0 +1,540 @@
+"""Fully-jitted canonical-RAMP environment stepping (the §5.8 north star).
+
+This module composes the proven jitted pieces — the block-search primitive
+(`sim/jax_block_search.py`), the lookahead tick engine (`sim/jax_lookahead.py`)
+— with a `lax.scan`-ified `allocate_job` (reference:
+ddls/environments/ramp_cluster/agents/placers/utils.py:532 ``allocate``, here
+re-derived from `agents/placers.py:allocate_job`) and array formulations of
+dep placement/pricing/scheduling into ONE jitted decision step and a jitted
+episode loop for the canonical RAMP partitioning environment
+(single-channel complete topology, whole-cluster meta block, one decided job
+per step — the `RampJobPartitioningEnvironment` path).
+
+Design: everything that depends only on (model, partition degree) is
+precomputed on the host into padded, stacked *config tables* — the
+partitioned graph arrays, placement scan order, collective grouping, SRPT
+tie ranks, candidate block shapes per split — and everything that depends on
+cluster state (free memory, server/channel occupancy, running jobs, the
+arrival clock) lives in small state arrays. A decision is then: gather the
+config row -> scan the padded forward-op sequence placing each op (parent
+co-location, else generic first-fit block search) -> price deps (collective
+symmetry test + the RAMP all-reduce formula) -> SRPT scores -> the jitted
+lookahead -> SLA gate -> masked commit. The episode loop advances the event
+clock (completions, arrivals) between decisions exactly like
+``RampClusterEnvironment.step``'s tick loop.
+
+Build state: the table builders and the scan-ified `jax_allocate_job`
+kernel (parity-fuzzed in tests/test_jax_placer.py) are landed; the pricing
+/ score / decision-step / episode kernels consume the dep, grouping, and
+rank tables stacked here and land on top.
+
+Numerics: tables are built in f64; under ``JAX_ENABLE_X64=1`` the whole
+step runs in f64 and is expected to reproduce host decisions exactly
+(the parity test runs that way); under default f32 results carry f32
+rounding — same trade as ``use_jax_lookahead``.
+
+Scope (honest): the placement-shaping env's restricted meta blocks and
+multi-channel topologies stay host-side; observation/GNN feature extraction
+is not in-kernel (the parity artifact replays recorded actions, the bench
+uses a constant-degree policy with the in-kernel action mask).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ddls_tpu.agents.block_search import block_shapes_for, factor_pairs
+from ddls_tpu.agents.partitioners import build_partition_action
+from ddls_tpu.graphs.readers import backward_op_id
+from ddls_tpu.sim.partition import partition_graph, partitioned_op_id
+
+Coord = Tuple[int, int, int]
+
+
+# =========================================================================
+# Shape system: the static candidate-block geometry for one RAMP topology.
+# =========================================================================
+
+@dataclasses.dataclass
+class ShapeTables:
+    """Distinct candidate block shapes for every possible split value, in
+    host `find_sub_block` order, as padded index tables.
+
+    ``row[s]`` lists (possibly duplicated) shape ids for split value ``s``
+    in exactly the host's scan order (`block_shapes_for` + the diagonal
+    fallback + the trailing (s,1,1)), with shapes whose origin span is
+    empty already dropped (the host skips them inside `first_fit_block`).
+    """
+    ramp_shape: Coord
+    shapes: List[Coord]            # distinct shapes (S==-1 -> diagonal)
+    row: np.ndarray                # [max_split+1, MAX_SHAPES] i32, -1 pad
+    offsets: np.ndarray            # [n_shapes, MAX_CELLS, 3] i32 cell offsets
+    counts: np.ndarray             # [n_shapes] i32 servers per block
+    bases: np.ndarray              # [n_shapes, 3] i32 modulo base per axis
+    spans: np.ndarray              # [n_shapes, 3] i32 origin span extents
+    diagonal: np.ndarray           # [n_shapes] bool
+
+
+def _shape_span(shape: Coord, meta: Coord) -> Coord:
+    # identical to first_fit_block's span arithmetic, including the S == -1
+    # quirk span[2] = meta[2] + 2 (agents/block_search.py:115-118)
+    return (meta[0] - shape[0] + 1, meta[1] - shape[1] + 1,
+            meta[2] - shape[2] + 1)
+
+
+def _shape_cells(shape: Coord) -> List[Coord]:
+    """Cell offsets at origin (0,0,0) — delegated to the host's
+    `enumerate_block` (with a huge phantom ramp so no modulo fires) so the
+    enumeration order can never diverge from it."""
+    from ddls_tpu.agents.block_search import enumerate_block
+
+    big = (1 << 20, 1 << 20, 1 << 20)
+    return enumerate_block(shape, big, (0, 0, 0))
+
+
+def build_shape_tables(ramp_shape: Coord, max_split: int) -> ShapeTables:
+    meta = tuple(ramp_shape)
+    per_split: Dict[int, List[Coord]] = {}
+    for s in range(1, max_split + 1):
+        if s != 1 and s % 2 != 0:
+            continue  # odd splits >1 cannot occur (RAMP symmetry)
+        shapes = block_shapes_for(factor_pairs(s), meta)
+        shapes += [(s, s, -1), (s, 1, 1)]
+        shapes = [sh for sh in shapes
+                  if all(x > 0 for x in _shape_span(sh, meta))]
+        per_split[s] = shapes
+
+    distinct: List[Coord] = []
+    index: Dict[Coord, int] = {}
+    for shapes in per_split.values():
+        for sh in shapes:
+            if sh not in index:
+                index[sh] = len(distinct)
+                distinct.append(sh)
+
+    max_row = max((len(v) for v in per_split.values()), default=1)
+    row = np.full((max_split + 1, max_row), -1, np.int32)
+    for s, shapes in per_split.items():
+        for p, sh in enumerate(shapes):
+            row[s, p] = index[sh]
+
+    n_shapes = max(len(distinct), 1)
+    cell_lists = [_shape_cells(sh) for sh in distinct]
+    max_cells = max((len(c) for c in cell_lists), default=1)
+    offsets = np.zeros((n_shapes, max_cells, 3), np.int32)
+    counts = np.zeros(n_shapes, np.int32)
+    bases = np.zeros((n_shapes, 3), np.int32)
+    spans = np.zeros((n_shapes, 3), np.int32)
+    diagonal = np.zeros(n_shapes, bool)
+    for i, sh in enumerate(distinct):
+        cells = cell_lists[i]
+        counts[i] = len(cells)
+        offsets[i, :len(cells)] = cells
+        diagonal[i] = sh[2] == -1
+        # enumerate_block's modulo: regular blocks wrap at ramp dims (a
+        # no-op inside the span), diagonals at (dim+1, dim+1, dim)
+        bases[i] = ((ramp_shape[0] + 1, ramp_shape[1] + 1, ramp_shape[2])
+                    if sh[2] == -1 else ramp_shape)
+        spans[i] = _shape_span(sh, meta)
+    return ShapeTables(ramp_shape=meta, shapes=distinct, row=row,
+                       offsets=offsets, counts=counts, bases=bases,
+                       spans=spans, diagonal=diagonal)
+
+
+# =========================================================================
+# Config tables: everything static per (model, partition degree).
+# =========================================================================
+
+@dataclasses.dataclass
+class ConfigPads:
+    n_ops: int        # N: padded partitioned-op slots
+    n_deps: int       # M: padded dep slots
+    n_fwd: int        # F: padded forward-op scan slots
+    n_parents: int    # P: padded parent-candidate slots
+    max_split: int    # maximum sub-ops per op (block size)
+    n_groups: int     # G: padded candidate collective groups
+    group_edges: int  # Eg: padded edges per candidate group
+    n_sync: int       # padded 2-edge sync pairs
+    n_o2o: int        # padded one-to-one edges
+
+
+def config_tables_for(graph, degree: int, quantum: float) -> dict:
+    """Unpadded per-(model, degree) tables (numpy, f64).
+
+    ``graph`` is the job's raw profile graph; ``degree`` the action (the
+    per-op split cap fed to the SiP-ML rule, reference:
+    agents/partitioners/sip_ml_op_partitioner.py:46).
+    """
+    from ddls_tpu.demands.job import Job
+    from ddls_tpu.sim.actions import build_grouping_arrays
+
+    if degree != 1 and degree % 2 != 0:
+        # build_shape_tables has no rows for odd splits > 1 (the RAMP
+        # symmetry rule the partitioners enforce); a silent all-fail row
+        # would diverge from the host placer, which happily scans
+        # factor_pairs(3) shapes
+        raise ValueError(f"degree must be 1 or even, got {degree}")
+    action = build_partition_action(graph, quantum, degree)
+    pgraph = partition_graph(graph, action)
+    arrays = pgraph.finalize()
+    n, m = pgraph.n_ops, pgraph.n_deps
+    op_index = arrays["op_index"]
+
+    original = Job(graph=graph, num_training_steps=1,
+                   max_acceptable_jct_frac=1.0, job_id=0,
+                   details={"model": "cfg", "job_idx": 0})
+    partitioned = Job(graph=pgraph, num_training_steps=1,
+                      max_acceptable_jct_frac=1.0, job_id=0,
+                      details={"model": "cfg", "job_idx": 0},
+                      original_job=original)
+
+    forward_graph = graph.forward_view()
+    n_forward = len(forward_graph.op_ids)
+    split_fwd = {str(int(op)): int(action.get(str(int(op)), 1))
+                 for op in forward_graph.op_ids}
+    split_fwd = {k: v for k, v in split_fwd.items() if v > 1}
+
+    topo = forward_graph.topo_order()
+    fwd_slot = {str(int(op)): i for i, op in enumerate(topo)}
+
+    f_split = np.zeros(len(topo), np.int32)
+    f_mem = np.zeros(len(topo), np.float64)
+    f_parents = []
+    f_sub_fwd = np.full((len(topo), degree if degree > 0 else 1), -1,
+                        np.int32)
+    f_sub_bwd = np.full_like(f_sub_fwd, -1)
+    insertion_rank = np.full(n, 0, np.int64)
+    ins = 0
+    for i, op in enumerate(topo):
+        op_s = str(int(op))
+        split = split_fwd.get(op_s, 1)
+        b_op = backward_op_id(op_s, n_forward)
+        mem = graph.memory_cost(op_s)
+        if graph.has_op(b_op):
+            mem += graph.memory_cost(b_op)
+        f_split[i] = split
+        f_mem[i] = mem / split
+        f_parents.append([fwd_slot[str(int(p))]
+                          for p in forward_graph.parents(op)])
+        for k in range(split):
+            if split > 1:
+                fid = op_index[partitioned_op_id(op_s, k)]
+                bid = op_index[partitioned_op_id(b_op, k)]
+            else:
+                fid = op_index[op_s]
+                bid = op_index[b_op]
+            f_sub_fwd[i, k] = fid
+            f_sub_bwd[i, k] = bid
+            # host insertion order: per placed server, fwd sub then bwd sub
+            # (agents/placers.py:67-74,91-98) — feeds the SRPT stable-sort
+            # tie-break (OpPlacement.worker_to_ops insertion order)
+            insertion_rank[fid] = ins
+            insertion_rank[bid] = ins + 1
+            ins += 2
+
+    grouping = build_grouping_arrays(original, partitioned, split_fwd)
+    cand = [g for g in grouping["groups"] if not g["sync"]]
+    sync = [g for g in grouping["groups"] if g["sync"]]
+    edge_size = arrays["edge_size"]
+
+    return {
+        "n_ops": n, "n_deps": m,
+        "op_compute": arrays["compute"].astype(np.float64),
+        "op_sorted_rank": arrays["op_sorted_rank"].astype(np.int32),
+        "num_parents": arrays["num_parents"].astype(np.int32),
+        "insertion_rank": insertion_rank.astype(np.int32),
+        "dep_src": arrays["edge_src"].astype(np.int32),
+        "dep_dst": arrays["edge_dst"].astype(np.int32),
+        "dep_size": edge_size.astype(np.float64),
+        "dep_mutual": arrays["edge_mutual"].astype(bool),
+        "dep_sorted_rank": arrays["edge_sorted_rank"].astype(np.int32),
+        "f_split": f_split, "f_mem": f_mem, "f_parents": f_parents,
+        "f_sub_fwd": f_sub_fwd, "f_sub_bwd": f_sub_bwd,
+        "groups": cand, "sync": sync,
+        "o2o_edges": grouping["o2o_edges"].astype(np.int32),
+        "seq_compute": float(arrays["compute"].sum()),
+    }
+
+
+def stack_config_tables(per_cfg: Sequence[dict],
+                        shape_tables: ShapeTables) -> Tuple[dict, ConfigPads]:
+    """Pad + stack per-config tables along a leading cfg axis."""
+    pads = ConfigPads(
+        n_ops=max(c["n_ops"] for c in per_cfg),
+        n_deps=max(c["n_deps"] for c in per_cfg),
+        n_fwd=max(len(c["f_split"]) for c in per_cfg),
+        n_parents=max((len(p) for c in per_cfg for p in c["f_parents"]),
+                      default=1) or 1,
+        max_split=int(shape_tables.counts.max()),
+        n_groups=max((len(c["groups"]) for c in per_cfg), default=1) or 1,
+        group_edges=max((len(g["edges"]) for c in per_cfg
+                         for g in c["groups"]), default=1) or 1,
+        n_sync=max((len(c["sync"]) for c in per_cfg), default=1) or 1,
+        n_o2o=max((len(c["o2o_edges"]) for c in per_cfg), default=1) or 1,
+    )
+    K = len(per_cfg)
+    N, M, F, P = pads.n_ops, pads.n_deps, pads.n_fwd, pads.n_parents
+    S = pads.max_split
+    G, Eg, Sy, O = (pads.n_groups, pads.group_edges, pads.n_sync,
+                    pads.n_o2o)
+
+    out = {
+        "n_ops": np.zeros(K, np.int32),
+        "n_deps": np.zeros(K, np.int32),
+        "n_fwd": np.zeros(K, np.int32),
+        "op_valid": np.zeros((K, N), bool),
+        "op_compute": np.zeros((K, N), np.float64),
+        "op_sorted_rank": np.zeros((K, N), np.int32),
+        "num_parents": np.zeros((K, N), np.int32),
+        "insertion_rank": np.zeros((K, N), np.int32),
+        "dep_valid": np.zeros((K, M), bool),
+        "dep_src": np.zeros((K, M), np.int32),
+        "dep_dst": np.zeros((K, M), np.int32),
+        "dep_size": np.zeros((K, M), np.float64),
+        "dep_mutual": np.zeros((K, M), bool),
+        "dep_sorted_rank": np.zeros((K, M), np.int32),
+        "f_valid": np.zeros((K, F), bool),
+        "f_split": np.ones((K, F), np.int32),
+        "f_mem": np.zeros((K, F), np.float64),
+        "f_parents": np.full((K, F, P), -1, np.int32),
+        "f_sub_fwd": np.full((K, F, S), -1, np.int32),
+        "f_sub_bwd": np.full((K, F, S), -1, np.int32),
+        "grp_valid": np.zeros((K, G), bool),
+        "grp_edges": np.full((K, G, Eg), -1, np.int32),
+        "grp_u": np.zeros((K, G, Eg), np.int32),
+        "grp_v": np.zeros((K, G, Eg), np.int32),
+        "grp_edge_valid": np.zeros((K, G, Eg), bool),
+        "grp_msg": np.zeros((K, G), np.float64),
+        "sync_valid": np.zeros((K, Sy), bool),
+        "sync_edges": np.full((K, Sy, 2), -1, np.int32),
+        "sync_u": np.zeros((K, Sy), np.int32),
+        "sync_v": np.zeros((K, Sy), np.int32),
+        "sync_msg": np.zeros((K, Sy), np.float64),
+        "o2o_valid": np.zeros((K, O), bool),
+        "o2o_edges": np.zeros((K, O), np.int32),
+        "seq_compute": np.zeros(K, np.float64),
+    }
+    for k, c in enumerate(per_cfg):
+        n, m, f = c["n_ops"], c["n_deps"], len(c["f_split"])
+        out["n_ops"][k], out["n_deps"][k], out["n_fwd"][k] = n, m, f
+        out["op_valid"][k, :n] = True
+        out["op_compute"][k, :n] = c["op_compute"]
+        out["op_sorted_rank"][k, :n] = c["op_sorted_rank"]
+        out["num_parents"][k, :n] = c["num_parents"]
+        out["insertion_rank"][k, :n] = c["insertion_rank"]
+        out["dep_valid"][k, :m] = True
+        out["dep_src"][k, :m] = c["dep_src"]
+        out["dep_dst"][k, :m] = c["dep_dst"]
+        out["dep_size"][k, :m] = c["dep_size"]
+        out["dep_mutual"][k, :m] = c["dep_mutual"]
+        out["dep_sorted_rank"][k, :m] = c["dep_sorted_rank"]
+        out["f_valid"][k, :f] = True
+        out["f_split"][k, :f] = c["f_split"]
+        out["f_mem"][k, :f] = c["f_mem"]
+        for i, parents in enumerate(c["f_parents"]):
+            out["f_parents"][k, i, :len(parents)] = parents
+        out["f_sub_fwd"][k, :f, :c["f_sub_fwd"].shape[1]] = c["f_sub_fwd"]
+        out["f_sub_bwd"][k, :f, :c["f_sub_bwd"].shape[1]] = c["f_sub_bwd"]
+        for gi, g in enumerate(c["groups"]):
+            ne = len(g["edges"])
+            out["grp_valid"][k, gi] = True
+            out["grp_edges"][k, gi, :ne] = g["edges"]
+            out["grp_u"][k, gi, :ne] = g["u"]
+            out["grp_v"][k, gi, :ne] = g["v"]
+            out["grp_edge_valid"][k, gi, :ne] = True
+            out["grp_msg"][k, gi] = g["msg"]
+        for si, g in enumerate(c["sync"]):
+            out["sync_valid"][k, si] = True
+            ne = len(g["edges"])
+            out["sync_edges"][k, si, :ne] = g["edges"]
+            out["sync_u"][k, si] = g["u"][0]
+            out["sync_v"][k, si] = g["v"][0]
+            out["sync_msg"][k, si] = g["msg"]
+        no = len(c["o2o_edges"])
+        out["o2o_valid"][k, :no] = True
+        out["o2o_edges"][k, :no] = c["o2o_edges"]
+        out["seq_compute"][k] = c["seq_compute"]
+    return out, pads
+
+
+
+
+# =========================================================================
+# The scan-ified allocate_job kernel.
+# =========================================================================
+
+def _anchor_masks(free_flat, st: ShapeTables):
+    """[n_shapes, n_cells] anchor-validity masks for EVERY distinct shape
+    given the flat free-server grid (True = free of other jobs AND enough
+    memory — block_ok's conjunction, agents/block_search.py:84-101).
+
+    Shapes and cell counts are static, so the per-cell gathers unroll at
+    trace time into pure vector ops on the [C, R, S] grid. Diagonal
+    anchors gather through the (dim+1) modulo with explicit in-ramp
+    masking (enumerate_block's S == -1 layout)."""
+    import jax.numpy as jnp
+
+    C, R, S = st.ramp_shape
+    free = free_flat.reshape(C, R, S)
+    ii, jj, kk = np.meshgrid(np.arange(C), np.arange(R), np.arange(S),
+                             indexing="ij")
+    masks = []
+    for si in range(len(st.shapes)):
+        cnt = int(st.counts[si])
+        span = st.spans[si]
+        base = st.bases[si]
+        ok = jnp.ones((C, R, S), bool)
+        for t in range(cnt):
+            off = st.offsets[si, t]
+            ci = (ii + int(off[0])) % int(base[0])
+            cj = (jj + int(off[1])) % int(base[1])
+            ck = (kk + int(off[2])) % int(base[2])
+            in_ramp = (ci < C) & (cj < R) & (ck < S)
+            cell_free = free[np.clip(ci, 0, C - 1),
+                             np.clip(cj, 0, R - 1),
+                             np.clip(ck, 0, S - 1)]
+            ok = ok & jnp.asarray(in_ramp) & cell_free
+        # origin span: the host scans diagonal origins k over
+        # meta[2] + 2 values, but k and k - S alias the same block, so
+        # the k < S anchors cover every class in the same first-fit order
+        in_span = jnp.asarray((ii < int(span[0])) & (jj < int(span[1]))
+                              & (kk < min(int(span[2]), S)))
+        masks.append((ok & in_span).reshape(-1))
+    return jnp.stack(masks)
+
+
+def _first_fit_from_masks(masks, shape_row):
+    """First-fit over a (traced) per-split shape-order row: returns
+    (shape_id, origin_rank, found) — the first shape in row order with any
+    valid anchor, and its smallest lexicographic anchor, exactly
+    `first_fit_block`'s (shape order, then origin lex order) semantics."""
+    import jax.numpy as jnp
+
+    n_cells = masks.shape[1]
+    big = jnp.int32(n_cells + 1)
+    lex = jnp.arange(n_cells, dtype=jnp.int32)
+
+    best_shape = jnp.int32(-1)
+    best_rank = big
+    found = jnp.bool_(False)
+    for p in range(shape_row.shape[0]):
+        sid = shape_row[p]
+        mask = masks[jnp.clip(sid, 0)] & (sid >= 0)
+        any_valid = mask.any()
+        rank = jnp.where(mask, lex, big).min()
+        take = any_valid & ~found
+        best_shape = jnp.where(take, sid, best_shape)
+        best_rank = jnp.where(take, rank, best_rank)
+        found = found | any_valid
+    return best_shape, best_rank, found
+
+
+def jax_allocate_job(mem, other_free, cfg, tables, st: ShapeTables,
+                     pads: ConfigPads):
+    """Scan-ified `allocate_job` (agents/placers.py:103; reference
+    placers/utils.py:532): walk the padded forward-op sequence in topo
+    order; per op try parent co-location then the generic first-fit block
+    search; scatter memory + op->server assignments between steps.
+
+    ``mem`` [n_srv] free memory per server; ``other_free`` [n_srv] bool
+    (True = not occupied by another job; constant during one job's
+    allocation); ``cfg`` the traced (model, degree) config row. Returns
+    (op_to_server [N] i32, -1 where unplaced, new_mem [n_srv], ok bool).
+    On ok=False outputs are partial and must be discarded by the caller
+    (the host returns None and the composite action drops the job)."""
+    import jax
+    import jax.numpy as jnp
+
+    C, R, S = st.ramp_shape
+    Smax = pads.max_split
+    F, N = pads.n_fwd, pads.n_ops
+
+    row_table = jnp.asarray(st.row)
+    offsets_t = jnp.asarray(st.offsets)
+    bases_t = jnp.asarray(st.bases)
+
+    f_valid = tables["f_valid"][cfg]
+    f_split = tables["f_split"][cfg]
+    f_mem = tables["f_mem"][cfg]
+    f_parents = tables["f_parents"][cfg]
+    f_sub_fwd = tables["f_sub_fwd"][cfg]
+    f_sub_bwd = tables["f_sub_bwd"][cfg]
+
+    lane = jnp.arange(Smax)
+
+    def body(carry, f):
+        (mem, op_servers, op_count, ots, ok) = carry
+        valid = f_valid[f]
+        split = f_split[f]
+        per_mem = f_mem[f]
+        parents = f_parents[f]
+        sub_fwd = f_sub_fwd[f]
+        sub_bwd = f_sub_bwd[f]
+
+        # ---- parent co-location (placers.py:49-77): first parent whose
+        # server count equals split and whose servers all have room
+        colo_found = jnp.bool_(False)
+        colo_servers = jnp.full((Smax,), -1, jnp.int32)
+        for pi in range(parents.shape[0]):
+            p = parents[pi]
+            servers = op_servers[jnp.clip(p, 0)]
+            cnt = op_count[jnp.clip(p, 0)]
+            active = lane < cnt
+            mem_ok = jnp.all(~active
+                             | (mem[jnp.clip(servers, 0)] >= per_mem))
+            okp = (p >= 0) & (cnt > 0) & (cnt == split) & mem_ok
+            take = okp & ~colo_found
+            colo_servers = jnp.where(take, servers, colo_servers)
+            colo_found = colo_found | okp
+
+        # ---- regular symmetric block search (find_sub_block order)
+        free = other_free & (mem >= per_mem)
+        masks = _anchor_masks(free, st)
+        shape_row = row_table[jnp.clip(split, 0, row_table.shape[0] - 1)]
+        sid, rank, block_found = _first_fit_from_masks(masks, shape_row)
+
+        origin = jnp.stack([rank // (R * S), (rank // S) % R,
+                            rank % S]).astype(jnp.int32)
+        offs = offsets_t[jnp.clip(sid, 0)]              # [MAX_CELLS, 3]
+        base = bases_t[jnp.clip(sid, 0)]                # [3]
+        cells = (origin[None, :] + offs) % base[None, :]
+        block_servers = ((cells[:, 0] * R + cells[:, 1]) * S
+                         + cells[:, 2]).astype(jnp.int32)
+        if block_servers.shape[0] < Smax:
+            block_servers = jnp.pad(block_servers,
+                                    (0, Smax - block_servers.shape[0]))
+        else:
+            block_servers = block_servers[:Smax]
+
+        servers = jnp.where(colo_found, colo_servers, block_servers)
+        placed_ok = colo_found | block_found
+
+        # ---- masked commit of this op's fwd+bwd sub-op pairs. Inactive
+        # lanes scatter into a trailing dummy slot so they can never
+        # collide with a real index.
+        active = (lane < split) & placed_ok & valid & (servers >= 0)
+        srv = jnp.clip(servers, 0)
+        mem = mem - jnp.zeros_like(mem).at[srv].add(
+            jnp.where(active, per_mem, jnp.zeros_like(per_mem)))
+        idx_f = jnp.where(active & (sub_fwd >= 0), sub_fwd, N)
+        idx_b = jnp.where(active & (sub_bwd >= 0), sub_bwd, N)
+        ots = ots.at[idx_f].set(servers)
+        ots = ots.at[idx_b].set(servers)
+
+        write = valid & placed_ok
+        op_servers = jnp.where(write, op_servers.at[f].set(servers),
+                               op_servers)
+        op_count = jnp.where(write, op_count.at[f].set(split), op_count)
+        return ((mem, op_servers, op_count, ots,
+                 ok & (placed_ok | ~valid)), None)
+
+    init = (mem,
+            jnp.full((F, Smax), -1, jnp.int32),
+            jnp.zeros((F,), jnp.int32),
+            jnp.full((N + 1,), -1, jnp.int32),   # +1 dummy scatter slot
+            jnp.bool_(True))
+    carry, _ = jax.lax.scan(body, init, jnp.arange(F, dtype=jnp.int32))
+    (new_mem, _, _, ots, ok) = carry
+    return ots[:N], new_mem, ok
