@@ -538,3 +538,213 @@ def jax_allocate_job(mem, other_free, cfg, tables, st: ShapeTables,
     carry, _ = jax.lax.scan(body, init, jnp.arange(F, dtype=jnp.int32))
     (new_mem, _, _, ots, ok) = carry
     return ots[:N], new_mem, ok
+
+
+# =========================================================================
+# Dep pricing + SRPT scores (the array mirror of assign_dep_run_times and
+# the SRPT schedulers, for a single placed job).
+# =========================================================================
+
+def _jnp_all_reduce_time(msg, n_servers, n_racks, n_cgs, *, x, rate,
+                         prop, io):
+    """Vectorised mirror of `sim/comm_model.py:ramp_all_reduce_time`
+    (reference: actions/utils.py:42-88), identical accumulation order so
+    f64 results match the host bit-for-bit. All span inputs are traced
+    f64 >= 1; ``msg`` static per group."""
+    import jax.numpy as jnp
+
+    mem_frequency, peak_flops, bytes_per_comp = 2e12, 130e12, 2
+    data_per_tx = rate / x
+
+    subs = [n_cgs, jnp.minimum(n_cgs, n_servers), n_racks,
+            jnp.ceil(n_servers / x)]
+    msg_sizes = [jnp.ceil(msg / subs[0])]
+    for sub in subs[1:]:
+        msg_sizes.append(jnp.ceil(msg_sizes[-1] / sub))
+
+    comm = jnp.zeros_like(msg)
+    comp = jnp.zeros_like(msg)
+    for step, sub in enumerate(subs):
+        live = sub > 1
+        safe_sub = jnp.where(live, sub, 2.0)
+        # parallel_add_time (comm_model.py:44-56)
+        n_op = jnp.ceil(jnp.log2(safe_sub))
+        n_bytes = (safe_sub + 1) * bytes_per_comp
+        ai = n_op / n_bytes
+        # host: parallel_add_time(msg_sizes[step] * sub, sub) computes
+        # n_op * (data_sz / devices) / bytes_per_comp; the product and
+        # quotient are exact in f64 at these magnitudes
+        total_ops = n_op * (msg_sizes[step] * safe_sub / safe_sub) \
+            / bytes_per_comp
+        add_t = total_ops / jnp.minimum(mem_frequency * ai, peak_flops)
+        comp = comp + jnp.where(live, add_t, 0.0)
+        # effective_transceivers(x, sub, J=1) (comm_model.py:34-41)
+        spare = jnp.minimum(jnp.floor(x / 1.0),
+                            jnp.floor(x / (safe_sub - 1))) - 1.0
+        bw = (1.0 + spare) * data_per_tx
+        comm = comm + jnp.where(
+            live, prop + 2 * io + msg_sizes[step] / bw, 0.0)
+    return 2 * comm + comp
+
+
+def jax_price_and_score(sc, cfg, tables, st: ShapeTables,
+                        pads: ConfigPads, comm: dict, pair_channel):
+    """Price every dep of one placed job and build the SRPT lookahead
+    scores — the array mirror of `assign_dep_run_times`
+    (sim/actions.py:436), `SRPTOpScheduler`/`SRPTDepScheduler`
+    (agents/schedulers.py) and the score assembly in
+    `build_native_lookahead_arrays` (sim/jax_lookahead.py:186).
+
+    ``sc`` [N] per-op server codes (grid-flattened, -1 pads). Returns
+    (times [M], is_flow [M], chan [M], op_score [N], dep_score [M]).
+    """
+    import jax.numpy as jnp
+
+    C, R, S = st.ramp_shape
+    n_srv = C * R * S
+    M, N = pads.n_deps, pads.n_ops
+    x = float(comm["x"])
+    rate, prop, io = comm["rate"], comm["prop"], comm["io"]
+
+    codes = np.arange(n_srv)
+    c_of_np = codes // (R * S)
+    r_of_np = (codes // S) % R
+    s_of_np = codes % S
+    c_of = jnp.asarray(c_of_np, jnp.int32)
+    r_of = jnp.asarray(r_of_np, jnp.int32)
+    s_of = jnp.asarray(s_of_np, jnp.int32)
+
+    dep_valid = tables["dep_valid"][cfg]
+    dep_src = tables["dep_src"][cfg]
+    dep_dst = tables["dep_dst"][cfg]
+    dep_size = tables["dep_size"][cfg]
+
+    scp = jnp.clip(sc, 0)
+    sc_src = scp[jnp.clip(dep_src, 0)]
+    sc_dst = scp[jnp.clip(dep_dst, 0)]
+    # THE flow predicate, traced: mirrors OpGraph.flow_mask_from_codes
+    # (graphs/op_graph.py:268) — the canonical numpy helper cannot run
+    # under trace, so this is the one sanctioned re-statement; its parity
+    # with the native path is pinned by tests/test_jax_pricing.py's
+    # is_flow comparison
+    is_flow = dep_valid & (dep_size > 0) & (sc_src != sc_dst)
+
+    dt = dep_size.dtype
+    times = jnp.zeros((M + 1,), dt)
+
+    def span_counts(present):
+        """Distinct (s, r, c) component counts among present servers;
+        present: [..., n_srv] bool."""
+        def cnt(comp_of_np, n_comp):
+            onehot = jnp.asarray(np.eye(n_comp)[comp_of_np], dt)
+            return ((present.astype(dt) @ onehot) > 0).sum(-1).astype(dt)
+        return (cnt(s_of_np, S), cnt(r_of_np, R), cnt(c_of_np, C))
+
+    # ---- candidate collective groups (symmetry-tested)
+    grp_valid = tables["grp_valid"][cfg]              # [G]
+    grp_edges = tables["grp_edges"][cfg]              # [G, Eg]
+    grp_u = tables["grp_u"][cfg]
+    grp_v = tables["grp_v"][cfg]
+    grp_ev = tables["grp_edge_valid"][cfg]            # [G, Eg]
+    grp_msg = tables["grp_msg"][cfg]                  # [G]
+
+    u_codes = scp[jnp.clip(grp_u, 0)]
+    v_codes = scp[jnp.clip(grp_v, 0)]
+    sentinel = jnp.int32(n_srv + 1)
+    u_sorted = jnp.sort(jnp.where(grp_ev, u_codes, sentinel), axis=1)
+    v_sorted = jnp.sort(jnp.where(grp_ev, v_codes, sentinel), axis=1)
+    symmetric = jnp.all(u_sorted == v_sorted, axis=1) & grp_valid
+
+    G, Eg = grp_u.shape
+    rows = jnp.broadcast_to(jnp.arange(G)[:, None], (G, 2 * Eg))
+    both = jnp.concatenate([u_codes, v_codes], axis=1)
+    both_valid = jnp.concatenate([grp_ev, grp_ev], axis=1)
+    present = jnp.zeros((G, n_srv), bool).at[
+        rows, jnp.clip(both, 0, n_srv - 1)].max(both_valid)
+    n_in_group = present.sum(-1)
+    cnt_s, cnt_r, cnt_c = span_counts(present)
+    grp_time = _jnp_all_reduce_time(
+        grp_msg, jnp.maximum(cnt_s, 1.0), jnp.maximum(cnt_r, 1.0),
+        jnp.maximum(cnt_c, 1.0), x=x, rate=rate, prop=prop, io=io)
+    grp_time = jnp.where(n_in_group <= 1, jnp.zeros_like(grp_time),
+                         grp_time)
+
+    # edges of asymmetric groups fall back to one-to-one pricing
+    # (assign_dep_run_times's extra_e path, sim/actions.py:505-540)
+    e_size = tables["dep_size"][cfg][jnp.clip(grp_edges, 0)]
+    e_same = u_codes == v_codes
+    e_o2o = jnp.where(e_same | (e_size == 0), jnp.zeros_like(e_size),
+                      prop + 2 * io + e_size / rate)
+    e_val = jnp.where(symmetric[:, None], grp_time[:, None], e_o2o)
+    times = times.at[jnp.where(grp_ev, grp_edges, M)].set(e_val)
+
+    # ---- sync pairs (always collectives; 2 servers or same-server zero)
+    sync_valid = tables["sync_valid"][cfg]            # [Sy]
+    sync_edges = tables["sync_edges"][cfg]            # [Sy, 2]
+    sync_u = scp[jnp.clip(tables["sync_u"][cfg], 0)]
+    sync_v = scp[jnp.clip(tables["sync_v"][cfg], 0)]
+    sync_msg = tables["sync_msg"][cfg]
+    same = sync_u == sync_v
+    scnt_s = jnp.where(s_of[sync_u] == s_of[sync_v], 1.0, 2.0)
+    scnt_r = jnp.where(r_of[sync_u] == r_of[sync_v], 1.0, 2.0)
+    scnt_c = jnp.where(c_of[sync_u] == c_of[sync_v], 1.0, 2.0)
+    sync_time = _jnp_all_reduce_time(sync_msg, scnt_s, scnt_r, scnt_c,
+                                     x=x, rate=rate, prop=prop, io=io)
+    sync_time = jnp.where(same, jnp.zeros_like(sync_time), sync_time)
+    sv = sync_valid[:, None] & (sync_edges >= 0)
+    times = times.at[jnp.where(sv, sync_edges, M)].set(
+        jnp.broadcast_to(sync_time[:, None], sync_edges.shape))
+
+    # ---- static one-to-one edges
+    o2o_valid = tables["o2o_valid"][cfg]
+    o2o_edges = tables["o2o_edges"][cfg]
+    o_size = tables["dep_size"][cfg][jnp.clip(o2o_edges, 0)]
+    o_src = sc_src[jnp.clip(o2o_edges, 0)]
+    o_dst = sc_dst[jnp.clip(o2o_edges, 0)]
+    o_val = jnp.where((o_src == o_dst) | (o_size == 0),
+                      jnp.zeros_like(o_size),
+                      prop + 2 * io + o_size / rate)
+    times = times.at[jnp.where(o2o_valid, o2o_edges, M)].set(o_val)
+
+    times = times[:M]
+    # the cluster zeroes non-flow dep run times at mount
+    # (cluster.py:_register_running_job:708-718); SRPT ranking below uses
+    # the RAW priced times because the schedulers run before the mount
+    mounted_times = jnp.where(is_flow, times, jnp.zeros_like(times))
+
+    # ---- SRPT dep priorities: one stable descending argsort over the
+    # priced costs in edge order (agents/schedulers.py:_srpt_priorities)
+    m = tables["n_deps"][cfg].astype(dt)
+    cost_key = jnp.where(dep_valid, -times, jnp.asarray(jnp.inf, dt))
+    order = jnp.lexsort((jnp.arange(M), cost_key))
+    dep_pri = jnp.zeros((M,), dt).at[order].set(
+        jnp.arange(M, dtype=dt))
+    # the lookahead engines read dep priorities off the channel mounts, so
+    # only FLOW deps carry their SRPT rank; non-flows score with priority 0
+    # (build_native_lookahead_arrays:249-263 prices flow_idx only)
+    dep_pri = jnp.where(is_flow, dep_pri, jnp.zeros_like(dep_pri))
+    dep_score = dep_pri * (m + 1) + (
+        m - tables["dep_sorted_rank"][cfg].astype(dt))
+
+    # ---- SRPT op priorities: per-worker stable sort by compute cost
+    # descending, insertion (placement) order breaking ties
+    # (agents/schedulers.py:29-38 + OpPlacement.worker_to_ops order)
+    op_valid = tables["op_valid"][cfg]
+    op_cost = tables["op_compute"][cfg]
+    ins = tables["insertion_rank"][cfg]
+    same_srv = (sc[:, None] == sc[None, :]) & (sc[:, None] >= 0)
+    before = (op_cost[None, :] > op_cost[:, None]) | (
+        (op_cost[None, :] == op_cost[:, None]) & (ins[None, :] < ins[:, None]))
+    op_pri = (same_srv & before & op_valid[None, :]).sum(1).astype(dt)
+    n = tables["n_ops"][cfg].astype(dt)
+    op_score = op_pri * (n + 1) + (
+        n - tables["op_sorted_rank"][cfg].astype(dt))
+
+    # ---- channels (single-channel complete topology: the direct link)
+    chan = jnp.where(is_flow,
+                     pair_channel[sc_src, sc_dst], jnp.int32(-1))
+    # the host raises on non-finite priced times (comm_model.py:99-100,
+    # actions.py:541-543); a traced kernel cannot, so callers must treat
+    # finite_ok=False as that hard failure
+    finite_ok = jnp.all(jnp.isfinite(mounted_times))
+    return mounted_times, is_flow, chan, op_score, dep_score, finite_ok

@@ -326,6 +326,10 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
     N = op_remaining.shape[0]
     E = dep_remaining.shape[0]
     max_iters = N + E + 4
+    # scalar accumulators follow the input dtype: f32 on the standard
+    # path, f64 when the caller runs under JAX_ENABLE_X64 (the jitted
+    # env-step parity mode, sim/jax_env.py)
+    dt = op_remaining.dtype
 
     worker_onehot = (jax.nn.one_hot(op_worker, num_workers, dtype=jnp.float32)
                      .T)  # [W, N]; -1 (padding) one-hots to zeros
@@ -401,7 +405,7 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
         safe_tick = jnp.where(new_stuck, 0.0, tick)
         comp_oh2 = comp_oh + jnp.where(ticked_ops, safe_tick, 0.0)
         comm_oh2 = comm_oh + jnp.where(ticked_flows, safe_tick, 0.0)
-        busy2 = busy + safe_tick * jnp.sum(sel_ops).astype(jnp.float32)
+        busy2 = busy + safe_tick * jnp.sum(sel_ops).astype(dt)
         t2 = t + safe_tick
 
         return (rem_op2, rem_dep2, op_done2, dep_done2, parent_done2,
@@ -410,8 +414,8 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
     init = (op_remaining, dep_remaining,
             jnp.zeros((N,), bool), jnp.zeros((E,), bool),
             jnp.zeros((N,), jnp.int32),
-            jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0),
-            jnp.float32(0.0), jnp.int32(0), jnp.bool_(False))
+            jnp.zeros((), dt), jnp.zeros((), dt), jnp.zeros((), dt),
+            jnp.zeros((), dt), jnp.int32(0), jnp.bool_(False))
     out = jax.lax.while_loop(cond, body, init)
     (_, _, op_done, dep_done, _, t, comm_oh, comp_oh, busy, it,
      stuck) = out
