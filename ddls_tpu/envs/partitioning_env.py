@@ -227,6 +227,15 @@ class RampJobPartitioningEnvironment:
         for job_idx in list(self.placed_job_idxs):
             if job_idx in self.cluster.jobs_blocked:
                 self.placed_job_idxs.discard(job_idx)
+        # stash the placed partitioned job BEFORE auto-stepping: if the
+        # episode ends during the auto-steps, episode finalisation sweeps
+        # still-running jobs into jobs_blocked (cluster.py:1009-1014) and
+        # JCT rewards could no longer find the placed job's lookahead
+        # details in any lifecycle dict
+        self.last_placed_job = (
+            self.cluster.jobs_running.get(self.last_job_arrived_job_idx)
+            if self.last_job_arrived_job_idx in self.placed_job_idxs
+            else None)
 
         # auto-step until another job queues or the episode ends, THEN
         # extract the reward so throughput rewards see the cluster steps in

@@ -209,6 +209,12 @@ class RampJobPlacementShapingEnvironment:
         for job_idx in list(self.placed_job_idxs):
             if job_idx in self.cluster.jobs_blocked:
                 self.placed_job_idxs.discard(job_idx)
+        # stash before auto-stepping: episode finalisation can sweep the
+        # placed job out of jobs_running (see partitioning_env.step)
+        self.last_placed_job = (
+            self.cluster.jobs_running.get(self.last_job_arrived_job_idx)
+            if self.last_job_arrived_job_idx in self.placed_job_idxs
+            else None)
 
         # auto-step to the next decision point, then extract the reward
         # (same ordering as the partitioning env)

@@ -12,6 +12,27 @@ def _log_transform(reward: float) -> float:
     return math.copysign(1, reward) * math.log(1 + abs(reward), 10)
 
 
+def _find_placed_job(env, cluster, job_idx):
+    """The placed partitioned job carrying the lookahead details.
+
+    Normally in jobs_running (or jobs_completed if it finished during the
+    auto-steps); when the EPISODE ends during the auto-steps, episode
+    finalisation sweeps still-running jobs into jobs_blocked and out of
+    every dict (cluster.py:1009-1014), so the env stashes the object as
+    ``last_placed_job`` before auto-stepping."""
+    job = (cluster.jobs_running.get(job_idx)
+           or cluster.jobs_completed.get(job_idx))
+    if job is None:
+        stashed = getattr(env, "last_placed_job", None)
+        if stashed is not None and stashed.details["job_idx"] == job_idx:
+            job = stashed
+    if job is None:
+        raise RuntimeError(
+            f"placed job idx {job_idx} is neither running, completed, "
+            "nor stashed")
+    return job
+
+
 class RewardFunction:
     def reset(self, env=None, **kwargs) -> None:
         pass
@@ -66,14 +87,7 @@ class LookaheadJobCompletionTime(RewardFunction):
         job_idx = env.last_job_arrived_job_idx
         cluster = env.cluster
         if job_idx in env.placed_job_idxs:
-            if job_idx in cluster.jobs_running:
-                job = cluster.jobs_running[job_idx]
-            elif job_idx in cluster.jobs_completed:
-                job = cluster.jobs_completed[job_idx]
-            else:
-                raise RuntimeError(
-                    f"placed job idx {job_idx} is neither running nor "
-                    "completed")
+            job = _find_placed_job(env, cluster, job_idx)
             reward = job.details["lookahead_job_completion_time"]
             if self.normaliser is not None and reward != 0:
                 reward = self._normalise(reward, job)
@@ -159,12 +173,7 @@ class MultiObjectiveJCTBlocking(RewardFunction):
         job_idx = env.last_job_arrived_job_idx
         cluster = env.cluster
         if job_idx in env.placed_job_idxs:
-            job = (cluster.jobs_running.get(job_idx)
-                   or cluster.jobs_completed.get(job_idx))
-            if job is None:
-                raise RuntimeError(
-                    f"placed job idx {job_idx} is neither running nor "
-                    "completed")
+            job = _find_placed_job(env, cluster, job_idx)
             reward = (job.details["lookahead_job_completion_time"]
                       / job.seq_completion_time)
         else:

@@ -138,3 +138,74 @@ def test_lookahead_jct_reward(dataset_dir):
         obs, reward, done, info = env.step(int(valid[-1]))
         if reward != pytest.approx(-1.0):
             assert -1.0 < reward < 0.0
+
+
+def _jct_env(dataset_dir, interarrival, sim_end, steps=40):
+    return RampJobPartitioningEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "max_files": 1,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": interarrival},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 1.0},
+            "replication_factor": 2,
+            "job_sampling_mode": "remove",
+            "num_training_steps": steps},
+        max_partitions_per_op=8,
+        min_op_run_time_quantum=0.01,
+        reward_function="multi_objective_jct_blocking",
+        reward_function_kwargs={"sign": -1, "blocking_weight": 1},
+        max_simulation_run_time=sim_end,
+        pad_obs_kwargs={"max_nodes": 150, "max_edges": 512},
+        apply_action_mask=True)
+
+
+def test_jct_reward_survives_episode_end_sweep(dataset_dir):
+    """When the episode ends during the AUTO-steps (after the placed-job
+    bookkeeping), cluster finalisation sweeps the still-running placed job
+    into jobs_blocked; JCT rewards must fall back to the env's
+    pre-auto-step stash instead of raising (regression: round-4 JCT
+    training crashed on 'placed job idx ... is neither running nor
+    completed').
+
+    Timeline engineered with a probed JCT T: job A placed at 0 (completes
+    at T), job B arrives at 0.6T and is placed; B's cluster step ends at
+    A's completion (T), the auto-steps then hit sim_end = 1.3T with B
+    still running -> B is swept while still in placed_job_idxs."""
+    probe = _jct_env(dataset_dir, interarrival=1e9, sim_end=1e12)
+    probe.reset(seed=0)
+    probe.step(1)
+    ji = probe.last_job_arrived_job_idx
+    probed = (probe.cluster.jobs_running.get(ji)
+              or probe.cluster.jobs_completed.get(ji))
+    T = probed.details["lookahead_job_completion_time"]
+
+    env = _jct_env(dataset_dir, interarrival=0.6 * T, sim_end=1.3 * T)
+    obs = env.reset(seed=0)
+    obs, r1, done, info = env.step(1)       # job A placed
+    assert not done
+    obs, r2, done, info = env.step(1)       # job B placed, then swept
+    assert done
+    ji = env.last_job_arrived_job_idx
+    assert ji in env.placed_job_idxs        # B passed every gate
+    assert ji not in env.cluster.jobs_running
+    assert ji not in env.cluster.jobs_completed
+    assert ji in env.cluster.jobs_blocked   # swept by finalisation
+    assert env.last_placed_job is not None
+    expected = -(env.last_placed_job.details[
+        "lookahead_job_completion_time"]
+        / env.last_placed_job.seq_completion_time)
+    assert r2 == pytest.approx(expected)
