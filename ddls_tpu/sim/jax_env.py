@@ -748,3 +748,334 @@ def jax_price_and_score(sc, cfg, tables, st: ShapeTables,
     # finite_ok=False as that hard failure
     finite_ok = jnp.all(jnp.isfinite(mounted_times))
     return mounted_times, is_flow, chan, op_score, dep_score, finite_ok
+
+
+# =========================================================================
+# The jitted decision step + episode loop.
+# =========================================================================
+
+# blocked-cause codes in the decision trace (mirrors the host's cause
+# strings: actions.py Action.job_id_to_cause_of_unsuccessful_handling +
+# cluster._register_blocked_job)
+CAUSE_ACCEPTED = 0
+CAUSE_NOT_HANDLED = 1        # action 0
+CAUSE_OP_PLACEMENT = 2
+CAUSE_DEP_PLACEMENT = 3
+CAUSE_SLA = 4                # max_acceptable_job_completion_time_exceeded
+CAUSE_ENGINE = 5             # lookahead non-convergence / non-finite price
+                             # (the host raises; must never appear)
+
+
+@dataclasses.dataclass
+class EpisodeTables:
+    """Everything static for a jitted canonical-RAMP episode."""
+    st: ShapeTables
+    tables: dict               # stacked config tables (jnp arrays)
+    pads: ConfigPads
+    types: List[str]           # model name -> type index (list order)
+    degrees: List[int]         # action degree -> cfg column (list order)
+    comm: dict                 # {x, rate, prop, io}
+    pair_channel: object       # [n_srv, n_srv] jnp i32
+    n_chan: int
+    n_srv: int
+    sim_end: float
+    eps: float                 # cluster.machine_epsilon
+    success_reward: float
+    fail_reward: float
+    worker_mem: float          # per-server memory capacity at reset
+
+
+def build_episode_tables(env, max_degree: Optional[int] = None,
+                         quantum: Optional[float] = None) -> EpisodeTables:
+    """Assemble the static side of the jitted episode from a host env
+    (canonical RAMP single-channel complete topology only)."""
+    import jax.numpy as jnp
+
+    topo = env.cluster.topology
+    dense = topo.dense_tables()
+    if dense["pair_channel"] is None:
+        raise ValueError("jitted episode needs a single-channel complete "
+                         "topology (canonical RAMP)")
+    max_degree = max_degree or env.max_partitions_per_op
+    quantum = quantum or env.min_op_run_time_quantum
+
+    gen = env.cluster.jobs_generator
+    # one profile graph per distinct model, in sorted-model order
+    model_graphs = {}
+    for proto in gen.sampler.prototypes:
+        model_graphs[proto.details["model"]] = proto.graph
+    types = sorted(model_graphs)
+    degrees = [d for d in range(1, max_degree + 1)
+               if d == 1 or d % 2 == 0]
+
+    st = build_shape_tables(topo.shape, min(max_degree, topo.num_workers))
+    cfgs = []
+    for m in types:
+        for d in degrees:
+            cfgs.append(config_tables_for(model_graphs[m], d, quantum))
+    tables, pads = stack_config_tables(cfgs, st)
+    jt = {k: jnp.asarray(v) for k, v in tables.items()}
+
+    from ddls_tpu.envs.rewards import JobAcceptance
+
+    if not isinstance(env.reward_function, JobAcceptance):
+        # other reward families read lookahead details off live Job
+        # objects; the jitted trace only carries the acceptance signal
+        raise ValueError(
+            "jitted episode replay supports the job_acceptance reward "
+            f"only, env has {type(env.reward_function).__name__}")
+    workers = list(topo.workers.values())
+    if len({w.memory_capacity for w in workers}) != 1:
+        raise ValueError("jitted episode needs homogeneous worker memory")
+    return EpisodeTables(
+        st=st, tables=jt, pads=pads, types=types, degrees=degrees,
+        comm={"x": topo.num_communication_groups,
+              "rate": topo.channel_bandwidth,
+              "prop": topo.intra_gpu_propagation_latency,
+              "io": topo.worker_io_latency},
+        pair_channel=jnp.asarray(dense["pair_channel"]),
+        n_chan=len(dense["channel_ids"]),
+        n_srv=topo.num_workers,
+        sim_end=float(env.max_simulation_run_time),
+        eps=env.cluster.machine_epsilon,
+        success_reward=getattr(env.reward_function, "success_reward", 1.0),
+        fail_reward=getattr(env.reward_function, "fail_reward", -1.0),
+        worker_mem=float(workers[0].memory_capacity))
+
+
+def build_job_bank(et: EpisodeTables, records: Sequence[dict]) -> dict:
+    """Job bank arrays from per-arrival records: each record carries
+    {model, num_training_steps, sla_frac, time_arrived}."""
+    J = len(records)
+    bank = {
+        "type": np.zeros(J, np.int32),
+        "steps": np.zeros(J, np.float64),
+        "sla_frac": np.zeros(J, np.float64),
+        "arrival_t": np.zeros(J + 1, np.float64),
+    }
+    for i, r in enumerate(records):
+        bank["type"][i] = et.types.index(r["model"])
+        bank["steps"][i] = r["num_training_steps"]
+        bank["sla_frac"][i] = r["sla_frac"]
+        bank["arrival_t"][i] = r["time_arrived"]
+    bank["arrival_t"][J] = np.inf
+    return bank
+
+
+def make_episode_fn(et: EpisodeTables):
+    """Build the jitted episode replay: (bank, actions [n_decisions]) ->
+    per-decision traces (reward, accept, cause, jct, t) + final counters.
+
+    One `lax.scan` over decisions; each decision runs the scan-ified
+    placer, the pricing/score kernel and the jitted lookahead under a
+    `lax.cond` (skipped for action 0), then a `lax.while_loop` advances
+    the event clock (completions, arrivals) to the next decision exactly
+    like `RampClusterEnvironment.step`'s tick loop (cluster.py:616-657).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    st, pads = et.st, et.pads
+    n_srv, n_chan = et.n_srv, et.n_chan
+    R = n_srv  # max concurrent jobs: every running job owns >= 1 server
+    n_deg = len(et.degrees)
+    # action value -> cfg column (-1 for odd/invalid actions)
+    deg_col = np.full(max(et.degrees) + 1, -1, np.int32)
+    for i, d in enumerate(et.degrees):
+        deg_col[d] = i
+    deg_col = jnp.asarray(deg_col)
+    eps = et.eps
+    sim_end = et.sim_end
+
+    def decision(bank, carry, action, row):
+        (t, mem, srv_job, chan_occ, slot_valid, slot_t_done, slot_mem,
+         slot_servers, slot_chan) = carry
+        dt = mem.dtype
+        jtype = bank["type"][row]
+        steps = bank["steps"][row].astype(dt)
+        cfg = jtype * n_deg + deg_col[jnp.clip(action, 0)]
+
+        def heavy(_):
+            other_free = srv_job < 0
+            ots, new_mem, ok_place = jax_allocate_job(
+                mem, other_free, cfg, et.tables, st, pads)
+            times, is_flow, chan, op_score, dep_score, finite_ok = \
+                jax_price_and_score(ots, cfg, et.tables, st, pads,
+                                    et.comm, et.pair_channel)
+            occ_vals = chan_occ[jnp.clip(chan, 0)]
+            ok_chan = jnp.all(~is_flow | (occ_vals < 0))
+
+            from ddls_tpu.sim.jax_lookahead import jax_lookahead
+            op_valid = et.tables["op_valid"][cfg]
+            t_step, _, _, _, ok_la = jax_lookahead(
+                et.tables["op_compute"][cfg], op_valid,
+                jnp.where(op_valid, ots, -1), op_score,
+                et.tables["num_parents"][cfg], times,
+                et.tables["dep_valid"][cfg], et.tables["dep_src"][cfg],
+                et.tables["dep_dst"][cfg], et.tables["dep_mutual"][cfg],
+                is_flow, dep_score, chan[:, None],
+                num_workers=n_srv, num_channels=n_chan)
+            jct = t_step * steps
+            max_jct = (bank["sla_frac"][row].astype(dt)
+                       * et.tables["seq_compute"][cfg].astype(dt) * steps)
+            sla_ok = ~(jct > max_jct)
+            engine_ok = ok_la & finite_ok
+            accept = ok_place & ok_chan & sla_ok & engine_ok
+            cause = jnp.where(
+                ~ok_place, CAUSE_OP_PLACEMENT,
+                jnp.where(~ok_chan, CAUSE_DEP_PLACEMENT,
+                          jnp.where(~engine_ok, CAUSE_ENGINE,
+                                    jnp.where(~sla_ok, CAUSE_SLA,
+                                              CAUSE_ACCEPTED))))
+            srv_mask = jnp.zeros((n_srv,), bool).at[
+                jnp.clip(ots, 0)].max(op_valid & (ots >= 0))
+            chan_mask = jnp.zeros((n_chan,), bool).at[
+                jnp.clip(chan, 0)].max(is_flow)
+            return (accept, cause.astype(jnp.int32), jct, new_mem,
+                    srv_mask, chan_mask)
+
+        def zero(_):
+            return (jnp.bool_(False), jnp.int32(CAUSE_NOT_HANDLED),
+                    jnp.zeros((), dt), mem, jnp.zeros((n_srv,), bool),
+                    jnp.zeros((n_chan,), bool))
+
+        # actions outside the jitted degree set (odd > 1 — the host
+        # coerces masked-invalid actions to 0, partitioning_env.py:195)
+        # take the zero path instead of wrapping deg_col's -1 into
+        # another config row
+        action_ok = (action > 0) & (deg_col[jnp.clip(action, 0)] >= 0)
+        (accept, cause, jct, new_mem, srv_mask, chan_mask) = jax.lax.cond(
+            action_ok, heavy, zero, operand=None)
+
+        slot = jnp.argmin(slot_valid).astype(jnp.int32)  # first free slot
+        accept = accept & ~jnp.all(slot_valid)  # cannot trigger (R=n_srv)
+        delta = mem - new_mem
+        mem2 = jnp.where(accept, new_mem, mem)
+        srv_job2 = jnp.where(accept & srv_mask, slot, srv_job)
+        chan_occ2 = jnp.where(accept & chan_mask, slot, chan_occ)
+        slot_valid2 = slot_valid.at[slot].set(
+            jnp.where(accept, True, slot_valid[slot]))
+        slot_t_done2 = slot_t_done.at[slot].set(
+            jnp.where(accept, t + jct, slot_t_done[slot]))
+        slot_mem2 = slot_mem.at[slot].set(
+            jnp.where(accept, delta, slot_mem[slot]))
+        slot_servers2 = slot_servers.at[slot].set(
+            jnp.where(accept, srv_mask, slot_servers[slot]))
+        slot_chan2 = slot_chan.at[slot].set(
+            jnp.where(accept, chan_mask, slot_chan[slot]))
+        reward = jnp.where(accept, et.success_reward, et.fail_reward)
+
+        return ((t, mem2, srv_job2, chan_occ2, slot_valid2, slot_t_done2,
+                 slot_mem2, slot_servers2, slot_chan2),
+                (reward.astype(dt), accept, cause, jct))
+
+    def advance(bank, carry, queue_row, ptr, next_arrival, done,
+                completed):
+        """Tick the event clock until a job queues or the episode ends
+        (cluster.py:616-657 + the env's auto-step loop)."""
+        (t, mem, srv_job, chan_occ, slot_valid, slot_t_done, slot_mem,
+         slot_servers, slot_chan) = carry
+        dt = mem.dtype
+        J = bank["type"].shape[0]
+
+        def cond(s):
+            (_, _, _, _, _, _, _, _, _, queue_row, _, _, done, _) = s
+            return (queue_row < 0) & ~done
+
+        def body(s):
+            (t, mem, srv_job, chan_occ, slot_valid, slot_t_done,
+             slot_mem, slot_servers, slot_chan, queue_row, ptr,
+             next_arrival, done, completed) = s
+            remaining = jnp.where(slot_valid, slot_t_done - t,
+                                  jnp.asarray(jnp.inf, dt))
+            tick = jnp.minimum(jnp.minimum(next_arrival - t, sim_end - t),
+                               remaining.min())
+            tick = jnp.maximum(tick, 0.0)
+            t2 = t + tick
+
+            completions = slot_valid & (slot_t_done - t2 - eps <= 0)
+            mem2 = mem + (completions.astype(dt) @ slot_mem)
+            freed_srv = (completions[:, None] & slot_servers).any(0)
+            freed_chan = (completions[:, None] & slot_chan).any(0)
+            srv_job2 = jnp.where(freed_srv, -1, srv_job)
+            chan_occ2 = jnp.where(freed_chan, -1, chan_occ)
+            slot_valid2 = slot_valid & ~completions
+            completed2 = completed + completions.sum().astype(jnp.int32)
+
+            arrived = (ptr < J) & (t2 + eps >= next_arrival)
+            queue_row2 = jnp.where(arrived, ptr, queue_row)
+            ptr2 = ptr + arrived.astype(jnp.int32)
+            next_arrival2 = jnp.where(
+                arrived, bank["arrival_t"][jnp.clip(ptr2, 0, J)],
+                next_arrival)
+
+            done2 = (t2 >= sim_end) | ((ptr2 >= J)
+                                       & ~slot_valid2.any()
+                                       & (queue_row2 < 0))
+            return (t2, mem2, srv_job2, chan_occ2, slot_valid2,
+                    slot_t_done, slot_mem, slot_servers, slot_chan,
+                    queue_row2, ptr2, next_arrival2, done2, completed2)
+
+        s = carry + (queue_row, ptr, next_arrival, done, completed)
+        s = jax.lax.while_loop(cond, body, s)
+        return s[:9], s[9], s[10], s[11], s[12], s[13]
+
+    def episode(bank, actions):
+        dt = et.tables["dep_size"].dtype
+
+        def scan_body(state, action):
+            (carry, queue_row, ptr, next_arrival, done, completed,
+             counters) = state
+            t = carry[0]
+            has_job = (queue_row >= 0) & ~done
+
+            def run(_):
+                new_carry, (reward, accept, cause, jct) = decision(
+                    bank, carry, action, jnp.clip(queue_row, 0))
+                return new_carry, reward, accept, cause, jct
+
+            def skip(_):
+                return (carry, jnp.zeros((), dt), jnp.bool_(False),
+                        jnp.int32(-1), jnp.zeros((), dt))
+
+            new_carry, reward, accept, cause, jct = jax.lax.cond(
+                has_job, run, skip, operand=None)
+            accepted, blocked, ret = counters
+            counters2 = (accepted + (has_job & accept),
+                         blocked + (has_job & ~accept),
+                         ret + jnp.where(has_job, reward, 0.0))
+            queue_row2 = jnp.where(has_job, -1, queue_row)
+            (carry3, queue_row3, ptr3, next_arrival3, done3,
+             completed3) = advance(bank, new_carry, queue_row2, ptr,
+                                   next_arrival, done, completed)
+            out = (reward, accept, cause, jct, t, has_job)
+            return ((carry3, queue_row3, ptr3, next_arrival3, done3,
+                     completed3, counters2), out)
+
+        J = bank["type"].shape[0]
+        carry0 = (jnp.zeros((), dt),                       # t
+                  jnp.full((n_srv,), et.worker_mem, dt),   # mem
+                  jnp.full((n_srv,), -1, jnp.int32),       # srv_job
+                  jnp.full((n_chan,), -1, jnp.int32),      # chan_occ
+                  jnp.zeros((R,), bool),                   # slot_valid
+                  jnp.zeros((R,), dt),                     # slot_t_done
+                  jnp.zeros((R, n_srv), dt),               # slot_mem
+                  jnp.zeros((R, n_srv), bool),             # slot_servers
+                  jnp.zeros((R, n_chan), bool))            # slot_chan
+        state0 = (carry0,
+                  jnp.int32(0),                            # queue_row: job 0
+                  jnp.int32(1),                            # ptr
+                  bank["arrival_t"][1],                    # next arrival
+                  jnp.bool_(False),
+                  jnp.int32(0),
+                  (jnp.int32(0), jnp.int32(0), jnp.zeros((), dt)))
+        final, trace = jax.lax.scan(scan_body, state0, actions)
+        (carry, queue_row, ptr, next_arrival, done, completed,
+         counters) = final
+        return {"trace": trace, "accepted": counters[0],
+                "blocked": counters[1], "ret": counters[2],
+                "completed": completed, "t": carry[0], "done": done}
+
+    # bank arrays are traced arguments: one compile serves every bank of
+    # the same shape (per-seed episodes, vmapped batches)
+    return jax.jit(episode)
