@@ -778,6 +778,7 @@ class EpisodeTables:
     pair_channel: object       # [n_srv, n_srv] jnp i32
     n_chan: int
     n_srv: int
+    max_action: int            # env.max_partitions_per_op (action bound)
     sim_end: float
     eps: float                 # cluster.machine_epsilon
     success_reward: float
@@ -798,6 +799,12 @@ def build_episode_tables(env, max_degree: Optional[int] = None,
                          "topology (canonical RAMP)")
     max_degree = max_degree or env.max_partitions_per_op
     quantum = quantum or env.min_op_run_time_quantum
+    if max_degree > topo.num_workers:
+        # config columns above num_workers would clamp onto smaller
+        # splits' shape rows inside the gather-based block search
+        raise ValueError(
+            f"max_degree {max_degree} exceeds the {topo.num_workers}-"
+            "worker topology; cap max_partitions_per_op")
 
     gen = env.cluster.jobs_generator
     # one profile graph per distinct model, in sorted-model order
@@ -836,6 +843,7 @@ def build_episode_tables(env, max_degree: Optional[int] = None,
         pair_channel=jnp.asarray(dense["pair_channel"]),
         n_chan=len(dense["channel_ids"]),
         n_srv=topo.num_workers,
+        max_action=int(env.max_partitions_per_op),
         sim_end=float(env.max_simulation_run_time),
         eps=env.cluster.machine_epsilon,
         success_reward=getattr(env.reward_function, "success_reward", 1.0),
@@ -853,6 +861,10 @@ def build_job_bank(et: EpisodeTables, records: Sequence[dict]) -> dict:
         "sla_frac": np.zeros(J, np.float64),
         "arrival_t": np.zeros(J + 1, np.float64),
     }
+    if records and records[0]["time_arrived"] != 0.0:
+        # the episode kernel seeds job 0 as queued at t=0, mirroring the
+        # cluster reset ("first arrival at t=0", cluster.py:175-177)
+        raise ValueError("job bank must start with a t=0 arrival")
     for i, r in enumerate(records):
         bank["type"][i] = et.types.index(r["model"])
         bank["steps"][i] = r["num_training_steps"]
@@ -879,8 +891,11 @@ def make_episode_fn(et: EpisodeTables):
     n_srv, n_chan = et.n_srv, et.n_chan
     R = n_srv  # max concurrent jobs: every running job owns >= 1 server
     n_deg = len(et.degrees)
-    # action value -> cfg column (-1 for odd/invalid actions)
-    deg_col = np.full(max(et.degrees) + 1, -1, np.int32)
+    # action value -> cfg column (-1 for odd/invalid actions); sized by
+    # the env's full action bound so no action can clamp onto a valid
+    # column through the gather
+    deg_col = np.full(max(et.max_action, max(et.degrees)) + 1, -1,
+                      np.int32)
     for i, d in enumerate(et.degrees):
         deg_col[d] = i
     deg_col = jnp.asarray(deg_col)

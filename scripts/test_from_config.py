@@ -15,6 +15,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddls_tpu.config import load_config, save_config
+from ddls_tpu.train.compat import apply_reference_compat
 from ddls_tpu.train import Logger, RLEvalLoop, make_epoch_loop
 from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
 from train_from_config import build_epoch_loop_kwargs
@@ -33,6 +34,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     cfg = load_config(args.config_path, args.config_name, args.overrides)
+    apply_reference_compat(cfg)
     experiment = cfg.get("experiment", {})
     test_seed = int(experiment.get("test_seed", 0))
     seed_everything(test_seed)

@@ -15,6 +15,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddls_tpu.config import instantiate, load_config, save_config
+from ddls_tpu.train.compat import apply_reference_compat
 from ddls_tpu.train import Logger
 from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
 
@@ -29,6 +30,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     cfg = load_config(args.config_path, args.config_name, args.overrides)
+    apply_reference_compat(cfg)
     experiment = cfg.get("experiment", {})
     seed = int(experiment.get("seed", 0))
     seed_everything(seed)

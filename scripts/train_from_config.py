@@ -23,6 +23,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ddls_tpu.config import load_config, save_config
+from ddls_tpu.train.compat import apply_reference_compat
 from ddls_tpu.train import Checkpointer, Launcher, Logger, make_epoch_loop
 from ddls_tpu.utils.common import seed_everything, unique_experiment_dir
 
@@ -70,6 +71,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     cfg = load_config(args.config_path, args.config_name, args.overrides)
+    apply_reference_compat(cfg)
     experiment = cfg.get("experiment", {})
 
     # XLA dump must be requested before the first backend init (SURVEY
