@@ -110,17 +110,21 @@ RAY_ALGO_PLUMBING = {
     "minibatch_buffer_size", "broadcast_interval", "after_train_step",
     "timeout_s_aggregator_manager", "replay_buffer_num_slots",
     "replay_proportion",
-    # schedule / optimizer variants the TPU learners fix
+    # schedule variants the TPU learners fix. NOTE: keys the TPU
+    # translators DO consume (opt_type, decay, momentum, epsilon,
+    # vtrace_clip_*, vtrace_drop_last_ts, report_length, eval_prob —
+    # train/loops.py _RLLIB_TO_IMPALA / es known tuple) must NOT appear
+    # here: this shim runs on native trees too, and stripping a consumed
+    # key would silently sweep a no-op
     "lr_schedule", "entropy_coeff_schedule", "use_critic", "use_gae",
-    "opt_type", "decay", "momentum", "epsilon", "_lr_vf",
-    "_separate_vf_optimizer", "_disable_preprocessor_api",
-    # vtrace variants (the TPU IMPALA always uses vtrace defaults)
-    "vtrace", "vtrace_clip_rho_threshold",
-    "vtrace_clip_pg_rho_threshold", "vtrace_drop_last_ts",
+    "_lr_vf", "_separate_vf_optimizer", "_disable_preprocessor_api",
+    # the vtrace on/off toggle itself (the TPU IMPALA is always vtrace)
+    "vtrace",
     # DQN head variants the TPU learner fixes
     "hiddens", "noisy", "sigma0", "v_max", "v_min",
-    # ES evaluation plumbing
-    "observation_filter", "report_length", "eval_prob",
+    # ES evaluation/noise-table plumbing (Ray's shared noise buffer; the
+    # TPU ES samples noise on device)
+    "observation_filter", "noise_size",
     # nested replay/exploration plumbing
     "type", "no_local_replay_buffer", "prioritized_replay",
     "replay_buffer_shards_colocated_with_driver",
@@ -187,9 +191,30 @@ def apply_reference_compat(cfg: Dict[str, Any]) -> Dict[str, Any]:
 
     loop = cfg.get("epoch_loop")
     if isinstance(loop, dict):
+        # the shaping tree's pre-group rllib_config.yaml keeps the trainer
+        # class inside epoch_loop (no algo group exists); hoist it so the
+        # algorithm selection survives the Ray-wiring drop below
+        trainer = loop.get("path_to_rllib_trainer_cls")
+        if (isinstance(trainer, str)
+                and "algo_name" not in (cfg.get("algo") or {})):
+            suffix = trainer.rsplit(".", 1)[-1]
+            if suffix not in TRAINER_TO_ALGO:
+                raise ValueError(
+                    f"unknown RLlib trainer class {trainer!r}; known: "
+                    f"{sorted(TRAINER_TO_ALGO)}")
+            cfg.setdefault("algo", {})["algo_name"] = \
+                TRAINER_TO_ALGO[suffix]
+            notes.append(f"epoch_loop.path_to_rllib_trainer_cls={trainer}"
+                         f" -> algo_name={cfg['algo']['algo_name']}")
         for key in sorted(set(loop) & EPOCH_LOOP_DROP):
             loop.pop(key)
-            notes.append(f"dropped epoch_loop.{key} (Ray wiring)")
+            notes.append(
+                f"dropped epoch_loop.{key} (Ray wiring; inline "
+                "rllib_config values are NOT translated — that legacy "
+                "pre-group surface is stale upstream: its env keys "
+                "crash the reference's own RampTopology)"
+                if key == "rllib_config"
+                else f"dropped epoch_loop.{key} (Ray wiring)")
 
     eval_cfg = cfg.get("eval_config")
     if isinstance(eval_cfg, dict):

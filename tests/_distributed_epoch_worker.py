@@ -1,0 +1,103 @@
+"""Worker for the 4-process REAL-epoch multi-host test.
+
+Each process owns 2 partitioning envs (process-distinct seeds by
+RLEpochLoop's built-in offset) and joins a global gloo mesh; two full
+collect+update epochs run on the REAL RampJobPartitioningEnvironment in a
+loaded, blocking-heavy regime so processes genuinely diverge in what
+their envs do (different blocking patterns — the deterministic-gate
+hazard class from CLAUDE.md's multi-host rules), while the nominally
+replicated parameters must stay BIT-identical on every process.
+
+Prints machine-checkable lines: PARAMS <sha1>, DIVERGE blocked=<n>.
+"""
+import hashlib
+import sys
+
+sys.path.insert(0, sys.argv[4] if len(sys.argv) > 4 else ".")
+
+from ddls_tpu.parallel import initialize_distributed
+
+
+def main() -> int:
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    initialize_distributed(coordinator_address=coordinator,
+                           num_processes=num_processes,
+                           process_id=process_id, platform="cpu")
+    import jax
+    import numpy as np
+
+    from ddls_tpu.train.loops import RLEpochLoop
+
+    env_config = {
+        "topology_config": {"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        "node_config": {"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        "jobs_config": {
+            # deterministic synthetic dataset: identical files on every
+            # process, so env CONFIG is process-identical while env
+            # BEHAVIOR diverges through the per-process collect seeds
+            "synthetic": {"n_cnn": 1, "n_translation": 1, "seed": 6,
+                          "min_ops": 6, "max_ops": 8},
+            "path_to_files": None,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 40.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.1, "max_val": 0.6, "decimals": 2},
+            "replication_factor": 20,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 20},
+        "max_partitions_per_op": 4,
+        "min_op_run_time_quantum": 0.01,
+        "reward_function": "job_acceptance",
+        "max_simulation_run_time": 2e3,
+        "pad_obs_kwargs": {"max_nodes": 32, "max_edges": 64},
+    }
+    model = {"fcnet_hiddens": [16], "custom_model_config": {
+        "out_features_msg": 4, "out_features_hidden": 8,
+        "out_features_node": 4, "out_features_graph": 4}}
+    algo_config = {"lr": 1e-3, "num_sgd_iter": 2,
+                   "sgd_minibatch_size": 8, "train_batch_size": 16}
+
+    loop = RLEpochLoop(
+        path_to_env_cls="ddls_tpu.envs.partitioning_env."
+                        "RampJobPartitioningEnvironment",
+        env_config=env_config, model=model, algo_config=algo_config,
+        num_envs=2, rollout_length=8, use_parallel_envs=False,
+        evaluation_interval=None, seed=0)
+    for _ in range(2):
+        results = loop.run()
+    assert results["epoch_counter"] == 2, results
+
+    # process-divergence evidence: per-process env blocking counters
+    blocked = sum(int(env.cluster.episode_stats["num_jobs_blocked"])
+                  + sum(e.get("num_jobs_blocked", 0)
+                        for e in getattr(env, "_episode_records", []))
+                  for env in loop.vec_env.envs)
+    arrived = sum(int(env.cluster.num_jobs_arrived)
+                  for env in loop.vec_env.envs)
+    print(f"DIVERGE process={process_id} blocked={blocked} "
+          f"arrived={arrived}", flush=True)
+
+    # parameters must be BIT-identical across processes
+    leaves = jax.tree_util.tree_leaves(jax.device_get(loop.state.params))
+    h = hashlib.sha1()
+    for leaf in leaves:
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    print(f"PARAMS process={process_id} digest={h.hexdigest()}",
+          flush=True)
+    loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
