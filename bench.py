@@ -203,6 +203,78 @@ def run_sim_bench(args) -> dict:
     }
 
 
+def run_jaxenv_bench(args) -> dict:
+    """Fully-jitted episode throughput (sim/jax_env.py): ONE device
+    dispatch runs a whole padded episode, so the tunnelled per-step RTT
+    that bounds host-driven stepping disappears. Measures compile time,
+    steady single-episode decisions/s, and the vmap-8 aggregate (the
+    rollout-collection shape; lockstep lanes lose on CPU, ride vector
+    lanes on TPU — docs/jax_env_gonogo.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.sim.jax_env import (build_episode_tables, build_job_bank,
+                                      make_episode_fn)
+
+    kwargs = make_env_kwargs(_make_dataset())
+    # loaded regime so the decisions bind (env_load32 analogue)
+    kwargs["jobs_config"]["job_interarrival_time_dist"]["val"] = 50.0
+    kwargs["jobs_config"]["num_training_steps"] = 20
+    kwargs["max_simulation_run_time"] = 2e4
+    kwargs["max_partitions_per_op"] = args.jaxenv_max_degree
+    env = RampJobPartitioningEnvironment(**kwargs)
+    env.reset(seed=0)
+    et = build_episode_tables(env)
+    episode_fn = make_episode_fn(et)
+
+    rng = np.random.RandomState(0)
+    J, D = 420, 400
+    degrees = [d for d in (0, 1, 2, 4, 8, 16)
+               if d <= args.jaxenv_max_degree]
+
+    def mk_bank(seed):
+        r = np.random.RandomState(seed)
+        recs = [{"model": et.types[int(r.randint(0, len(et.types)))],
+                 "num_training_steps": 20,
+                 "sla_frac": round(float(r.uniform(0.1, 1.0)), 2),
+                 "time_arrived": 50.0 * i} for i, _ in enumerate(range(J))]
+        return {k: jnp.asarray(v)
+                for k, v in build_job_bank(et, recs).items()}
+
+    actions = jnp.asarray(rng.choice(degrees, size=D), jnp.int32)
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(episode_fn(mk_bank(0), actions))
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(episode_fn(mk_bank(1), actions))
+    single_s = time.perf_counter() - t0
+    n_dec = int(np.asarray(out["trace"][5]).sum())
+
+    vfn = jax.jit(jax.vmap(episode_fn, in_axes=(0, 0)))
+    banks = [mk_bank(s) for s in range(8)]
+    bb = {k: jnp.stack([b[k] for b in banks]) for k in banks[0]}
+    aa = jnp.broadcast_to(actions, (8, D))
+    jax.block_until_ready(vfn(bb, aa))
+    t0 = time.perf_counter()
+    vout = jax.block_until_ready(vfn(bb, aa))
+    vmap_s = time.perf_counter() - t0
+    vdec = int(np.asarray(vout["trace"][5]).sum())
+
+    return {
+        "metric": "jaxenv_decisions_per_sec",
+        "value": round(n_dec / single_s, 2),
+        "unit": "decisions/s",
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "platform": jax.devices()[0].platform,
+        "compile_seconds": round(compile_s, 1),
+        "vmap8_decisions_per_sec": round(vdec / vmap_s, 2),
+        "max_degree": args.jaxenv_max_degree,
+        "pads": {"ops": et.pads.n_ops, "deps": et.pads.n_deps},
+    }
+
+
 def run_bench(args, platform_note: str | None,
               process_start: float) -> dict:
     import jax
@@ -349,8 +421,11 @@ def run_bench(args, platform_note: str | None,
 def main(argv=None) -> int:
     process_start = time.perf_counter()
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", choices=("ppo", "sim"), default="ppo",
-                        help="ppo: full train loop; sim: pure env stepping")
+    parser.add_argument("--mode", choices=("ppo", "sim", "jaxenv"),
+                        default="ppo",
+                        help="ppo: full train loop; sim: pure env "
+                             "stepping; jaxenv: fully-jitted episodes")
+    parser.add_argument("--jaxenv-max-degree", type=int, default=8)
     parser.add_argument("--num-envs", type=int, default=None)
     parser.add_argument("--rollout-length", type=int, default=32)
     parser.add_argument("--timed-epochs", type=int, default=3)
@@ -376,6 +451,30 @@ def main(argv=None) -> int:
             # one subprocess env worker per core (reference: 8 rollout
             # workers); more would just oversubscribe the host
             args.num_envs = max(2, min(16, cores))
+
+    if args.mode == "jaxenv":
+        # uses whatever backend is alive (the point IS the accelerator);
+        # probe first so a wedged tunnel still yields a JSON line
+        platform_note = None
+        err = probe_backend(args.probe_timeout)
+        if err is not None:
+            platform_note = f"default backend unusable ({err}); cpu"
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        try:
+            payload = run_jaxenv_bench(args)
+            if platform_note:
+                payload["platform_note"] = platform_note
+            emit(payload)
+            return 0
+        except Exception:
+            tb = traceback.format_exc().strip().splitlines()
+            emit({"metric": "jaxenv_decisions_per_sec", "value": None,
+                  "unit": "decisions/s", "vs_baseline": None,
+                  "error": " | ".join(tb[-3:])})
+            return 1
 
     if args.mode == "sim":
         # no device in the loop: never touch the (possibly hanging) TPU
