@@ -874,16 +874,12 @@ def build_job_bank(et: EpisodeTables, records: Sequence[dict]) -> dict:
     return bank
 
 
-def make_episode_fn(et: EpisodeTables):
-    """Build the jitted episode replay: (bank, actions [n_decisions]) ->
-    per-decision traces (reward, accept, cause, jct, t) + final counters.
+def _episode_kernels(et: EpisodeTables):
+    """Shared decision / event-clock / initial-state kernels for the
+    replay (`make_episode_fn`) and policy (`make_policy_episode_fn`)
+    episodes."""
+    import types as _types
 
-    One `lax.scan` over decisions; each decision runs the scan-ified
-    placer, the pricing/score kernel and the jitted lookahead under a
-    `lax.cond` (skipped for action 0), then a `lax.while_loop` advances
-    the event clock (completions, arrivals) to the next decision exactly
-    like `RampClusterEnvironment.step`'s tick loop (cluster.py:616-657).
-    """
     import jax
     import jax.numpy as jnp
 
@@ -1035,6 +1031,45 @@ def make_episode_fn(et: EpisodeTables):
         s = jax.lax.while_loop(cond, body, s)
         return s[:9], s[9], s[10], s[11], s[12], s[13]
 
+    def init_state(bank):
+        dt = et.tables["dep_size"].dtype
+        carry0 = (jnp.zeros((), dt),                       # t
+                  jnp.full((n_srv,), et.worker_mem, dt),   # mem
+                  jnp.full((n_srv,), -1, jnp.int32),       # srv_job
+                  jnp.full((n_chan,), -1, jnp.int32),      # chan_occ
+                  jnp.zeros((R,), bool),                   # slot_valid
+                  jnp.zeros((R,), dt),                     # slot_t_done
+                  jnp.zeros((R, n_srv), dt),               # slot_mem
+                  jnp.zeros((R, n_srv), bool),             # slot_servers
+                  jnp.zeros((R, n_chan), bool))            # slot_chan
+        return (carry0,
+                jnp.int32(0),                              # queue_row: job 0
+                jnp.int32(1),                              # ptr
+                bank["arrival_t"][1],                      # next arrival
+                jnp.bool_(False),
+                jnp.int32(0),
+                (jnp.int32(0), jnp.int32(0), jnp.zeros((), dt)))
+
+    return _types.SimpleNamespace(decision=decision, advance=advance,
+                                  init_state=init_state)
+
+
+def make_episode_fn(et: EpisodeTables):
+    """Build the jitted episode replay: (bank, actions [n_decisions]) ->
+    per-decision traces (reward, accept, cause, jct, t) + final counters.
+
+    One `lax.scan` over decisions; each decision runs the scan-ified
+    placer, the pricing/score kernel and the jitted lookahead under a
+    `lax.cond` (skipped for action 0), then a `lax.while_loop` advances
+    the event clock (completions, arrivals) to the next decision exactly
+    like `RampClusterEnvironment.step`'s tick loop (cluster.py:616-657).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k = _episode_kernels(et)
+    decision, advance = k.decision, k.advance
+
     def episode(bank, actions):
         dt = et.tables["dep_size"].dtype
 
@@ -1067,23 +1102,7 @@ def make_episode_fn(et: EpisodeTables):
             return ((carry3, queue_row3, ptr3, next_arrival3, done3,
                      completed3, counters2), out)
 
-        J = bank["type"].shape[0]
-        carry0 = (jnp.zeros((), dt),                       # t
-                  jnp.full((n_srv,), et.worker_mem, dt),   # mem
-                  jnp.full((n_srv,), -1, jnp.int32),       # srv_job
-                  jnp.full((n_chan,), -1, jnp.int32),      # chan_occ
-                  jnp.zeros((R,), bool),                   # slot_valid
-                  jnp.zeros((R,), dt),                     # slot_t_done
-                  jnp.zeros((R, n_srv), dt),               # slot_mem
-                  jnp.zeros((R, n_srv), bool),             # slot_servers
-                  jnp.zeros((R, n_chan), bool))            # slot_chan
-        state0 = (carry0,
-                  jnp.int32(0),                            # queue_row: job 0
-                  jnp.int32(1),                            # ptr
-                  bank["arrival_t"][1],                    # next arrival
-                  jnp.bool_(False),
-                  jnp.int32(0),
-                  (jnp.int32(0), jnp.int32(0), jnp.zeros((), dt)))
+        state0 = k.init_state(bank)
         final, trace = jax.lax.scan(scan_body, state0, actions)
         (carry, queue_row, ptr, next_arrival, done, completed,
          counters) = final
@@ -1093,4 +1112,197 @@ def make_episode_fn(et: EpisodeTables):
 
     # bank arrays are traced arguments: one compile serves every bank of
     # the same shape (per-seed episodes, vmapped batches)
+    return jax.jit(episode)
+
+
+# =========================================================================
+# In-kernel observations + policy-in-the-loop episodes (the full
+# HBM-resident rollout: obs, policy forward, sampling, decision, event
+# clock — all inside one lax.scan).
+# =========================================================================
+
+def build_obs_tables(env, et: EpisodeTables) -> dict:
+    """Static per-type observation rows + the normalisation constants the
+    kernel needs to rebuild the job-specific entries.
+
+    Everything in the standard observation (envs/obs.py) except seven
+    entries is a pure function of the job's MODEL: node/edge features and
+    most graph features. The seven dynamic entries are rebuilt in-kernel:
+    graph_features[2,3,8] (sequential JCT / max-acceptable JCT / training
+    steps — functions of the bank row), graph_features[4,5] (SLA frac),
+    graph_features[15,16] (cluster occupancy), plus the action mask.
+    """
+    gen = env.cluster.jobs_generator
+    obs_fn = env.observation_function
+    params = gen.jobs_params
+
+    proto_by_model = {}
+    for proto in gen.sampler.prototypes:
+        proto_by_model.setdefault(proto.details["model"], proto)
+
+    rows = []
+    for model in et.types:
+        job = proto_by_model[model]
+        obs = obs_fn.encode(job, env)
+        rows.append({k: np.asarray(v) for k, v in obs.items()})
+
+    def stack(key):
+        return np.stack([r[key] for r in rows])
+
+    def bounds(key):
+        return (float(params[f"min_{key}"]), float(params[f"max_{key}"]))
+
+    return {
+        "node_features": stack("node_features"),
+        "edge_features": stack("edge_features"),
+        "edges_src": stack("edges_src"),
+        "edges_dst": stack("edges_dst"),
+        "node_split": stack("node_split"),
+        "edge_split": stack("edge_split"),
+        "graph_features": stack("graph_features"),
+        # the exact compute.sum() the host multiplies by num_training_steps
+        # (demands/job.py:55) — dividing seq_completion_time back out by
+        # steps would cost an ulp and break the bit-equal obs contract
+        "orig_seq_sum": np.array(
+            [float(proto_by_model[m].graph.finalize()["compute"].sum())
+             for m in et.types], np.float64),
+        "seq_bounds": bounds("job_sequential_completion_times"),
+        "jct_bounds": bounds("max_acceptable_job_completion_times"),
+        "frac_bounds": bounds("max_acceptable_job_completion_time_fracs"),
+        "steps_bounds": bounds("job_num_training_steps"),
+        # static per-action "a symmetric block shape exists" row
+        # (envs/obs.py:action_is_valid:56-59)
+        "shapes_exist": np.array(
+            [bool(block_shapes_for(factor_pairs(a), et.st.ramp_shape))
+             for a in range(et.max_action + 1)], bool),
+    }
+
+
+def _kernel_obs(ot: dict, et: EpisodeTables, jtype, frac, steps,
+                n_occupied, n_running):
+    """Rebuild the exact host observation for one queued job inside jit.
+
+    Dynamic entries are computed with the host's formulas (f64) and the
+    whole feature vector is cast to f32 like the host encoder, so the
+    policy sees bit-identical inputs."""
+    import jax.numpy as jnp
+
+    def norm(val, lo, hi):
+        return jnp.where(hi - lo == 0, 1.0, (val - lo) / (hi - lo))
+
+    gf = jnp.asarray(ot["graph_features"])[jtype].astype(jnp.float64)
+    seq_ct = jnp.asarray(ot["orig_seq_sum"])[jtype] * steps
+    max_jct = frac * seq_ct
+    gf = gf.at[2].set(norm(seq_ct, *ot["seq_bounds"]))
+    gf = gf.at[3].set(norm(max_jct, *ot["jct_bounds"]))
+    gf = gf.at[4].set(norm(frac, *ot["frac_bounds"]))
+    gf = gf.at[5].set(frac)
+    gf = gf.at[8].set(norm(steps, *ot["steps_bounds"]))
+    n_srv = et.n_srv
+    gf = gf.at[15].set(n_occupied / n_srv)
+    gf = gf.at[16].set(n_running / n_srv)
+
+    # action mask (envs/obs.py:action_is_valid): 0 always; 1 needs a free
+    # worker; even a needs a <= free workers AND an existing block shape
+    free = n_srv - n_occupied
+    a = jnp.arange(et.max_action + 1)
+    exists = jnp.asarray(ot["shapes_exist"])
+    mask = ((a == 0)
+            | ((a == 1) & (free >= 1))
+            | ((a > 1) & (a % 2 == 0) & (a <= free) & exists))
+    n_feat = jnp.asarray(ot["graph_features"]).shape[1]
+    gf17 = jnp.clip(gf[:n_feat - mask.shape[0]], 0.0, 1.0)
+    gf = jnp.concatenate([gf17, mask.astype(jnp.float64)])
+
+    return {
+        "action_set": jnp.arange(et.max_action + 1, dtype=jnp.int32),
+        "node_features": jnp.asarray(ot["node_features"])[jtype],
+        "edge_features": jnp.asarray(ot["edge_features"])[jtype],
+        "edges_src": jnp.asarray(ot["edges_src"])[jtype],
+        "edges_dst": jnp.asarray(ot["edges_dst"])[jtype],
+        "node_split": jnp.asarray(ot["node_split"])[jtype],
+        "edge_split": jnp.asarray(ot["edge_split"])[jtype],
+        "graph_features": gf.astype(jnp.float32),
+        "action_mask": mask.astype(jnp.int32),
+    }
+
+
+def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
+                           greedy: bool = False):
+    """Full policy-in-the-loop jitted episode: (bank, params, rng) ->
+    traces. Per decision the kernel rebuilds the observation, runs the
+    GNN policy forward, samples (or argmaxes) an action under the mask,
+    then executes the decision + event clock exactly like
+    `make_episode_fn`. ONE device dispatch per episode — the complete
+    §5.8 HBM-resident rollout shape; vmap over (bank, rng) for batched
+    collection."""
+    import jax
+    import jax.numpy as jnp
+
+    k = _episode_kernels(et)
+
+    def episode(bank, params, rng):
+        dt = et.tables["dep_size"].dtype
+
+        def scan_body(state, step_rng):
+            (carry, queue_row, ptr, next_arrival, done, completed,
+             counters) = state
+            t = carry[0]
+            has_job = (queue_row >= 0) & ~done
+            row = jnp.clip(queue_row, 0)
+
+            def run(_):
+                # obs rebuild + GNN forward + sampling live INSIDE the
+                # cond so dead scan steps after episode end cost nothing
+                srv_job = carry[2]
+                slot_valid = carry[4]
+                obs = _kernel_obs(
+                    ot, et, bank["type"][row],
+                    bank["sla_frac"][row].astype(jnp.float64),
+                    bank["steps"][row].astype(jnp.float64),
+                    (srv_job >= 0).sum(), slot_valid.sum())
+                logits, value = model.apply(params, obs)
+                if greedy:
+                    action = jnp.argmax(logits).astype(jnp.int32)
+                else:
+                    action = jax.random.categorical(
+                        step_rng, logits).astype(jnp.int32)
+                logp = jax.nn.log_softmax(logits)[action]
+                new_carry, (reward, accept, cause, jct) = k.decision(
+                    bank, carry, action, row)
+                return (new_carry, action, logp, value, reward, accept,
+                        cause, jct)
+
+            def skip(_):
+                f32 = jnp.float32
+                return (carry, jnp.int32(0), f32(0.0), f32(0.0),
+                        jnp.zeros((), dt), jnp.bool_(False),
+                        jnp.int32(-1), jnp.zeros((), dt))
+
+            (new_carry, action, logp, value, reward, accept, cause,
+             jct) = jax.lax.cond(has_job, run, skip, operand=None)
+            accepted, blocked, ret = counters
+            counters2 = (accepted + (has_job & accept),
+                         blocked + (has_job & ~accept),
+                         ret + jnp.where(has_job, reward, 0.0))
+            queue_row2 = jnp.where(has_job, -1, queue_row)
+            (carry3, queue_row3, ptr3, next_arrival3, done3,
+             completed3) = k.advance(bank, new_carry, queue_row2,
+                                     ptr, next_arrival, done,
+                                     completed)
+            out = (action, logp, value, reward, accept, cause, jct, t,
+                   has_job)
+            return ((carry3, queue_row3, ptr3, next_arrival3, done3,
+                     completed3, counters2), out)
+
+        state0 = k.init_state(bank)
+        n_steps = bank["type"].shape[0]
+        rngs = jax.random.split(rng, n_steps)
+        final, trace = jax.lax.scan(scan_body, state0, rngs)
+        counters = final[6]
+        return {"trace": trace, "accepted": counters[0],
+                "blocked": counters[1], "ret": counters[2],
+                "completed": final[5], "t": final[0][0],
+                "done": final[4]}
+
     return jax.jit(episode)

@@ -1,0 +1,147 @@
+"""Policy-in-the-loop jitted episodes: the in-kernel observation must
+equal the host encoder bit-for-bit (f32), and a greedy GNN policy rolled
+out INSIDE the jitted episode must reproduce the host env driven by the
+same policy — actions, rewards, counters.
+
+x64 subprocess (same isolation as tests/test_jax_episode.py): the
+simulator side runs f64 for exact decision parity while the policy side
+is f32 on both paths."""
+import os
+import subprocess
+import sys
+
+DRIVER = r"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+assert jax.config.read("jax_enable_x64")
+
+import tempfile
+from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+from ddls_tpu.envs import RampJobPartitioningEnvironment
+from ddls_tpu.models.policy import GNNPolicy
+from ddls_tpu.sim.jax_env import (build_episode_tables, build_job_bank,
+                                  build_obs_tables, _kernel_obs,
+                                  make_policy_episode_fn)
+
+d = tempfile.mkdtemp(prefix="jax_pol_ep_")
+generate_pipedream_txt_files(d, n_cnn=2, n_translation=1, seed=5)
+
+def make_env():
+    return RampJobPartitioningEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 4,
+            "num_racks_per_communication_group": 4,
+            "num_servers_per_rack": 2, "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 32, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={"path_to_files": d,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 40.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.1, "max_val": 1.0, "decimals": 2},
+            "replication_factor": 30, "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 20},
+        max_partitions_per_op=8, min_op_run_time_quantum=0.01,
+        reward_function="job_acceptance", max_simulation_run_time=4e3,
+        pad_obs_kwargs={"max_nodes": 150, "max_edges": 512})
+
+env = make_env()
+obs = env.reset(seed=17)
+et = build_episode_tables(env)
+ot = build_obs_tables(env, et)
+
+model = GNNPolicy(n_actions=env.max_partitions_per_op + 1,
+                  out_features_msg=8, out_features_hidden=16,
+                  out_features_node=8, out_features_graph=4,
+                  fcnet_hiddens=(32,))
+params = model.init(jax.random.PRNGKey(3),
+                    jax.tree_util.tree_map(jnp.asarray, obs))
+
+# ---- host episode driven by the greedy policy, recording everything
+rng = np.random.RandomState(0)
+arrivals, actions, rewards = [], [], []
+seen = set()
+obs_checked = 0
+done = False
+while not done:
+    job = next(iter(env.cluster.job_queue.jobs.values()))
+    ji = env.cluster.job_id_to_job_idx[job.job_id]
+    if ji not in seen:
+        seen.add(ji)
+        arrivals.append({"model": job.details["model"],
+                         "num_training_steps": job.num_training_steps,
+                         "sla_frac": job.max_acceptable_jct_frac,
+                         "time_arrived": job.details["time_arrived"]})
+    # in-kernel obs parity vs the host encoder at THIS live state
+    jtype = et.types.index(job.details["model"])
+    kobs = _kernel_obs(ot, et, jnp.int32(jtype),
+                       jnp.float64(job.max_acceptable_jct_frac),
+                       jnp.float64(job.num_training_steps),
+                       jnp.int32(len(env.cluster.mounted_workers)),
+                       jnp.int32(len(env.cluster.jobs_running)))
+    for key in obs:
+        a = np.asarray(kobs[key])
+        b = np.asarray(obs[key])
+        assert a.dtype == b.dtype or key in ("action_mask",), (
+            key, a.dtype, b.dtype)
+        assert np.array_equal(a.astype(b.dtype), b), (
+            f"obs field {key} diverged at decision {len(actions)}:"
+            f" {a} vs {b}")
+    obs_checked += 1
+
+    logits, value = model.apply(params, jax.tree_util.tree_map(
+        jnp.asarray, obs))
+    action = int(np.argmax(np.asarray(logits)))
+    actions.append(action)
+    obs, reward, done, info = env.step(action)
+    rewards.append(reward)
+
+n_arrived = env.cluster.num_jobs_arrived
+for ji in range(len(arrivals), n_arrived):
+    j = (env.cluster.jobs_running.get(ji)
+         or env.cluster.jobs_completed.get(ji)
+         or env.cluster.jobs_blocked.get(ji)
+         or env.cluster.job_queue.jobs.get(env.cluster.job_idx_to_job_id[ji]))
+    j = j.original_job if j.original_job is not j else j
+    arrivals.append({"model": j.details["model"],
+                     "num_training_steps": j.num_training_steps,
+                     "sla_frac": j.max_acceptable_jct_frac,
+                     "time_arrived": j.details["time_arrived"]})
+print(f"host: {len(actions)} decisions, obs checked {obs_checked}")
+
+# ---- jitted policy episode on the same bank
+bank = {k: jnp.asarray(v) for k, v in build_job_bank(et, arrivals).items()}
+episode_fn = make_policy_episode_fn(et, ot, model, greedy=True)
+out = episode_fn(bank, params, jax.random.PRNGKey(0))
+(a_tr, logp_tr, v_tr, r_tr, acc_tr, cause_tr, jct_tr, t_tr,
+ has_tr) = (np.asarray(x) for x in out["trace"])
+n = int(has_tr.sum())
+assert n == len(actions), (n, len(actions))
+live = has_tr.nonzero()[0]
+assert (a_tr[live] == np.array(actions)).all(), "action trace diverged"
+assert np.allclose(r_tr[live], np.array(rewards)), "reward trace diverged"
+assert int(out["accepted"]) + int(out["blocked"]) == len(actions)
+host_ret = float(np.sum(rewards))
+assert abs(float(out["ret"]) - host_ret) < 1e-9, (out["ret"], host_ret)
+print(f"POLICY_EPISODE_PARITY_OK decisions={n} ret={host_ret}")
+"""
+
+
+def test_policy_episode_parity_x64():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    res = subprocess.run([sys.executable, "-c", DRIVER], env=env,
+                         capture_output=True, text=True, timeout=1800)
+    assert res.returncode == 0, (res.stdout[-4000:], res.stderr[-4000:])
+    assert "POLICY_EPISODE_PARITY_OK" in res.stdout, res.stdout[-2000:]
