@@ -28,6 +28,7 @@ id against a per-device dict and is constantly False
 """
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -38,6 +39,14 @@ from ddls_tpu.envs import spaces
 NODE_FEATURE_DIM = 5
 EDGE_FEATURE_DIM = 2
 GRAPH_FEATURE_DIM = 17
+
+
+@lru_cache(maxsize=None)
+def _block_shape_exists(action: int, ramp_shape: tuple) -> bool:
+    """Static per-(action, topology) half of the validity test, memoised:
+    this runs per action per decision on the hot path (both the mask
+    encoder and candidate pricing call it)."""
+    return bool(block_shapes_for(factor_pairs(action), ramp_shape))
 
 
 def action_is_valid(action: int, env) -> bool:
@@ -53,20 +62,28 @@ def action_is_valid(action: int, env) -> bool:
         return False
     if action == 1:
         return True
-    ramp_shape = env.cluster.topology.shape
     # valid iff some symmetric block shape of `action` servers fits the
     # topology; block_shapes_for already filters to fitting shapes
-    return bool(block_shapes_for(factor_pairs(action), ramp_shape))
+    return _block_shape_exists(action, env.cluster.topology.shape)
 
 
 class RampJobPartitioningObservation:
     def __init__(self,
                  max_partitions_per_op: int,
                  pad_obs_kwargs: Optional[dict] = None,
-                 machine_epsilon: float = 1e-7):
+                 machine_epsilon: float = 1e-7,
+                 include_candidate_prices: bool = False):
         self.max_partitions_per_op = max_partitions_per_op
         self.pad_obs_kwargs = pad_obs_kwargs or {}
         self.machine_epsilon = machine_epsilon
+        # opt-in decision-time candidate-price features: one entry per
+        # action, min(priced lookahead JCT / max-acceptable JCT, 2)/2 —
+        # 0.5 is exactly the SLA boundary, 1.0 = unpriceable/unplaceable.
+        # This is the information OracleJCT acts on; exposing it makes
+        # the oracle's policy linearly representable from the observation
+        # (docs/results_round4/RESULTS.md §3). Requires the env's
+        # candidate_pricing to be enabled.
+        self.include_candidate_prices = include_candidate_prices
         self.max_nodes = int(self.pad_obs_kwargs.get("max_nodes", 0))
         # the reference pads edges to the fully-connected worst-case bound
         # (jobs_generator.py:320-324); that is hugely wasteful on TPU (the
@@ -92,7 +109,10 @@ class RampJobPartitioningObservation:
             "edge_features": spaces.Box(
                 0.0, 1.0, (max_e, EDGE_FEATURE_DIM), np.float32),
             "graph_features": spaces.Box(
-                0.0, 1.0, (GRAPH_FEATURE_DIM + n_actions,), np.float32),
+                0.0, 1.0,
+                (GRAPH_FEATURE_DIM + n_actions
+                 + (n_actions if self.include_candidate_prices else 0),),
+                np.float32),
             "edges_src": spaces.Box(0, max_n - 1, (max_e,), np.int32),
             "edges_dst": spaces.Box(0, max_n - 1, (max_e,), np.int32),
             "node_split": spaces.Box(0, max_n, (1,), np.int32),
@@ -128,6 +148,9 @@ class RampJobPartitioningObservation:
         action_set, action_mask = self.get_action_set_and_mask(env)
         graph_feats = np.concatenate(
             [graph_feats, action_mask.astype(np.float32)])
+        if self.include_candidate_prices:
+            graph_feats = np.concatenate(
+                [graph_feats, self._price_features(job, env)])
 
         srcs = arrays["edge_src"].astype(np.int32)
         dsts = arrays["edge_dst"].astype(np.int32)
@@ -149,6 +172,21 @@ class RampJobPartitioningObservation:
             if not np.all(np.isfinite(val)):
                 raise ValueError(f"observation field {key} contains NaN/inf")
         return obs
+
+    def _price_features(self, job, env) -> np.ndarray:
+        """Per-action priced-JCT/SLA ratios (candidate_pricing must be on;
+        see __init__). Encoded so 0.5 is the acceptance boundary."""
+        if not getattr(env, "candidate_pricing", None):
+            raise ValueError(
+                "include_candidate_prices needs the env's "
+                "candidate_pricing enabled")
+        prices = getattr(env, "candidate_prices", None) or {}
+        limit = max(job.max_acceptable_jct, 1e-30)
+        feats = np.ones(self.max_partitions_per_op + 1, np.float32)
+        for a, priced in prices.items():
+            if priced is not None:
+                feats[a] = min(priced[0] / limit, 2.0) / 2.0
+        return feats
 
     def _node_features(self, job, arrays) -> np.ndarray:
         compute, memory, depth = (arrays["compute"], arrays["memory"],

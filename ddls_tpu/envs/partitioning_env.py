@@ -68,6 +68,7 @@ class RampJobPartitioningEnvironment:
                  use_native_lookahead: str | bool = "auto",
                  apply_action_mask: bool = True,
                  candidate_pricing: Optional[str] = None,
+                 obs_include_candidate_prices: bool = False,
                  **kwargs):
         self.topology_config = topology_config
         self.node_config = node_config
@@ -110,8 +111,12 @@ class RampJobPartitioningEnvironment:
         if observation_function != "ramp_job_partitioning_observation":
             raise ValueError(
                 f"unrecognised observation_function {observation_function!r}")
+        if obs_include_candidate_prices and not candidate_pricing:
+            raise ValueError(
+                "obs_include_candidate_prices requires candidate_pricing")
         self.observation_function = RampJobPartitioningObservation(
-            self.max_partitions_per_op, pad_obs_kwargs=pad_obs_kwargs)
+            self.max_partitions_per_op, pad_obs_kwargs=pad_obs_kwargs,
+            include_candidate_prices=obs_include_candidate_prices)
 
         self.action_set = list(range(self.max_partitions_per_op + 1))
         self.action_space = spaces.Discrete(len(self.action_set))
@@ -142,8 +147,10 @@ class RampJobPartitioningEnvironment:
         self.observation_space = self.observation_function.observation_space
         self.reward_function.reset(env=self)
         self.information_function.reset(self)
-        self.obs = self._get_observation()
+        # prices BEFORE the observation: price features (opt-in) describe
+        # the job the observation is about, not the previous decision's
         self._price_candidates()
+        self.obs = self._get_observation()
         return self.obs
 
     def _is_done(self) -> bool:
@@ -253,8 +260,8 @@ class RampJobPartitioningEnvironment:
 
         self.done = self._is_done()
         if not self.done:
-            self.obs = self._get_observation()
             self._price_candidates()
+            self.obs = self._get_observation()
         else:
             # no next decision: stale prices must not leak into terminal info
             self.candidate_prices = {}

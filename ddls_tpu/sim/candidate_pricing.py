@@ -50,13 +50,13 @@ def price_candidate_degrees(env, degrees=None,
         return {}
     job_id, job = next(iter(cluster.job_queue.jobs.items()))
     if degrees is None:
-        degrees = [a for a in env.action_set if a != 0]
-        mask = None
-        obs = getattr(env, "obs", None)
-        if isinstance(obs, dict):
-            mask = obs.get("action_mask")
-        if mask is not None:
-            degrees = [a for a in degrees if mask[a]]
+        # compute action validity directly: pricing now runs BEFORE the
+        # observation is extracted (so price features can describe the
+        # current job), and env.obs would be the PREVIOUS decision's mask
+        from ddls_tpu.envs.obs import action_is_valid
+
+        degrees = [a for a in env.action_set
+                   if a != 0 and action_is_valid(a, env)]
 
     results: Dict[int, Optional[PriceTuple]] = {}
     pending = []  # (degree, key, partitioned, context)

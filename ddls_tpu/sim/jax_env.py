@@ -1134,6 +1134,12 @@ def build_obs_tables(env, et: EpisodeTables) -> dict:
     """
     gen = env.cluster.jobs_generator
     obs_fn = env.observation_function
+    if getattr(obs_fn, "include_candidate_prices", False):
+        # price features are decision-time values of the queued job; the
+        # static per-type template cannot carry them and _kernel_obs does
+        # not rebuild them (yet) — refuse rather than silently mis-slice
+        raise ValueError("the jitted episode does not support "
+                         "obs_include_candidate_prices")
     params = gen.jobs_params
 
     proto_by_model = {}

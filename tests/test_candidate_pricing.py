@@ -165,3 +165,45 @@ def test_oracle_jct_respects_sla_better_than_approximation(dataset_dir):
     oracle = run(OracleJCT(max_partitions_per_op=8), "native")
     approx = run(AcceptableJCT(max_partitions_per_op=8), None)
     assert oracle >= approx, (oracle, approx)
+
+
+def test_price_features_in_observation(dataset_dir):
+    """obs_include_candidate_prices appends one priced-JCT/SLA ratio per
+    action, 0.5 at the acceptance boundary, 1.0 for unpriceable, matching
+    env.candidate_prices exactly at every decision (prices are computed
+    BEFORE the observation so they describe the CURRENT queued job)."""
+    env = RampJobPartitioningEnvironment(
+        **_env_kwargs(dataset_dir, candidate_pricing="native",
+                      obs_include_candidate_prices=True))
+    obs = env.reset(seed=5)
+    n_actions = env.max_partitions_per_op + 1
+    base_dim = env.observation_space["graph_features"].shape[0] - n_actions
+    rng = np.random.RandomState(1)
+    checked = 0
+    for _ in range(12):
+        job = next(iter(env.cluster.job_queue.jobs.values()))
+        feats = np.asarray(obs["graph_features"])[base_dim:]
+        assert feats.shape == (n_actions,)
+        limit = job.max_acceptable_jct
+        for a in range(n_actions):
+            priced = env.candidate_prices.get(a)
+            if priced is not None:
+                expected = min(priced[0] / max(limit, 1e-30), 2.0) / 2.0
+                assert feats[a] == pytest.approx(expected, rel=1e-6), a
+                # boundary semantics: <= 0.5 iff the SLA accepts it
+                assert (feats[a] <= 0.5 + 1e-9) == (priced[0] <= limit
+                                                    or feats[a] == 0.5)
+                checked += 1
+            else:
+                assert feats[a] == 1.0
+        valid = np.nonzero(np.asarray(obs["action_mask"]))[0]
+        obs, _, done, _ = env.step(int(rng.choice(valid)))
+        if done:
+            break
+    assert checked >= 8
+
+
+def test_price_features_require_pricing(dataset_dir):
+    with pytest.raises(ValueError, match="requires candidate_pricing"):
+        RampJobPartitioningEnvironment(
+            **_env_kwargs(dataset_dir, obs_include_candidate_prices=True))
