@@ -1,0 +1,90 @@
+"""ES trained entirely on device: population fitness = jitted policy
+episodes (no host simulator in the training loop). Mechanics are asserted
+hard (shapes, finiteness, fitness ordering, parameter movement); learning
+progress is reported, not asserted (3 generations of a tiny config is not
+a convergence test)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ddls_tpu.envs import RampJobPartitioningEnvironment
+from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+from ddls_tpu.models.policy import GNNPolicy
+from ddls_tpu.parallel.mesh import make_mesh
+from ddls_tpu.rl.es import ESConfig, ESLearner
+from ddls_tpu.rl.es_device import train_es_on_device
+from ddls_tpu.sim.jax_env import (build_episode_tables, build_job_bank,
+                                  build_obs_tables)
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("es_device_jobs"))
+    generate_pipedream_txt_files(d, n_cnn=1, n_translation=1, seed=4)
+    env = RampJobPartitioningEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2, "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={"path_to_files": d,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 60.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.2, "max_val": 1.0, "decimals": 2},
+            "replication_factor": 10,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 10},
+        max_partitions_per_op=4, min_op_run_time_quantum=0.01,
+        reward_function="job_acceptance", max_simulation_run_time=1.5e3,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+    obs = env.reset(seed=0)
+    et = build_episode_tables(env)
+    ot = build_obs_tables(env, et)
+    model = GNNPolicy(n_actions=5, out_features_msg=4,
+                      out_features_hidden=8, out_features_node=4,
+                      out_features_graph=4, fcnet_hiddens=(16,))
+    params = model.init(jax.random.PRNGKey(1),
+                        jax.tree_util.tree_map(jnp.asarray, obs))
+    return env, et, ot, model, params
+
+
+def test_es_generations_run_fully_on_device(setup):
+    env, et, ot, model, params = setup
+    learner = ESLearner(lambda p, o: model.apply(p, o),
+                        ESConfig(stepsize=0.02, noise_stdev=0.05),
+                        make_mesh(1), population=8)
+
+    def sample_bank(gen):
+        r = np.random.RandomState(100 + gen)
+        J = 26
+        recs = [{"model": et.types[int(r.randint(0, len(et.types)))],
+                 "num_training_steps": 10,
+                 "sla_frac": round(float(r.uniform(0.2, 1.0)), 2),
+                 "time_arrived": 60.0 * i} for i in range(J)]
+        return {k: jnp.asarray(v)
+                for k, v in build_job_bank(et, recs).items()}
+
+    final_params, history = train_es_on_device(
+        et, ot, model, learner, params, sample_bank, n_generations=3,
+        seed=0)
+
+    assert len(history) == 3
+    for h in history:
+        assert np.isfinite(h["fitness_mean"])
+        assert h["fitness_min"] <= h["fitness_mean"] <= h["fitness_max"]
+    # parameters moved under the ES update
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        params, final_params)
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
+    print("fitness trajectory:",
+          [round(h["fitness_mean"], 2) for h in history])
