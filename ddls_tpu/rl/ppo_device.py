@@ -1,0 +1,72 @@
+"""PPO with ON-DEVICE rollout collection.
+
+The collection half of PPO-on-device (§5.8): fixed-length [T, B]
+segments are produced by `sim/jax_env.py:make_segment_fn` — the entire
+environment (placement, pricing, lookahead, event clock, observation,
+policy forward, sampling) runs inside one jitted scan per env, vmapped
+over B job banks, with episodes resetting in-kernel. The host
+reconstructs the exact observations from the compact trace
+(`rebuild_obs_batch` — bit-equal to what the kernel's policy forward
+saw) and feeds the EXISTING `PPOLearner.shard_traj`/`train_step`.
+
+Under the tunnelled TPU this replaces T×B host→device round trips per
+collect (~116 ms each) with ONE dispatch.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+class DevicePPOCollector:
+    """Drop-in counterpart of `rl/rollout.py:RolloutCollector` whose envs
+    live on device. ``banks`` is a dict of stacked job-bank arrays with a
+    leading B axis (same shapes per bank)."""
+
+    def __init__(self, et, ot, model, banks: Dict, rollout_length: int):
+        import jax
+        import jax.numpy as jnp
+
+        from ddls_tpu.sim.jax_env import make_segment_fn, segment_init
+
+        self.et, self.ot, self.model = et, ot, model
+        self.banks = banks
+        self.rollout_length = rollout_length
+        self.num_envs = int(jax.tree_util.tree_leaves(banks)[0].shape[0])
+        segment = make_segment_fn(et, ot, model, rollout_length)
+        self._vseg = jax.jit(jax.vmap(segment, in_axes=(0, None, 0, 0)))
+        # per-env initial state from each env's OWN bank (arrival clocks
+        # differ across banks)
+        self._state = jax.vmap(lambda b: segment_init(et, b))(banks)
+
+    def collect(self, params, rng) -> Dict:
+        """One [T, B] segment batch; returns the PPOLearner traj dict
+        plus bootstrap values."""
+        import jax
+
+        from ddls_tpu.models.policy import batched_policy_apply
+        from ddls_tpu.sim.jax_env import rebuild_obs_batch
+
+        rngs = jax.random.split(rng, self.num_envs)
+        self._state, trace, next_fields = self._vseg(
+            self.banks, params, self._state, rngs)
+        trace = {k: np.asarray(v) for k, v in trace.items()}
+        # kernel trace is [B, T]; the learner wants [T, B]
+        trace = {k: np.swapaxes(v, 0, 1) for k, v in trace.items()}
+        obs = rebuild_obs_batch(self.et, self.ot, trace)
+        traj = {
+            "obs": obs,
+            "actions": trace["action"].astype(np.int32),
+            "logp": trace["logp"].astype(np.float32),
+            "values": trace["value"].astype(np.float32),
+            "rewards": trace["reward"].astype(np.float32),
+            "dones": trace["done"].astype(bool),
+        }
+        next_obs = rebuild_obs_batch(self.et, self.ot, {
+            k: np.asarray(v) for k, v in next_fields.items()})
+        _, last_values = batched_policy_apply(self.model, params, {
+            k: np.asarray(v) for k, v in next_obs.items()})
+        return {"traj": traj,
+                "last_values": np.asarray(last_values, np.float32),
+                "env_steps": self.rollout_length * self.num_envs}

@@ -1312,3 +1312,112 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
                 "done": final[4]}
 
     return jax.jit(episode)
+
+
+# =========================================================================
+# Fixed-length segment collection (the PPO rollout shape): the env lives
+# on device across collect calls; episodes reset in-kernel.
+# =========================================================================
+
+def segment_init(et: EpisodeTables, bank):
+    """Initial carried simulator state for `make_segment_fn`."""
+    return _episode_kernels(et).init_state(bank)
+
+
+def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
+    """(bank, params, sim_state, rng) -> (new_sim_state, trace, next_fields)
+
+    Exactly ``n_steps`` policy decisions per call — the [T, B] segment
+    shape PPO consumes — with the simulator state carried across calls
+    and episodes resetting IN-KERNEL to a fresh run of the same bank when
+    they end (``done`` marks the boundary step, so GAE truncates there).
+
+    The trace carries, per step: action, logp, value, reward, done, and
+    the compact observation fields (jtype, sla frac, steps, occupied
+    count, running count) from which `rebuild_obs_batch` reconstructs the
+    exact observation on host for the learner's re-forward.
+    ``next_fields`` are the same fields for the bootstrap state after the
+    segment.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k = _episode_kernels(et)
+
+    def obs_fields(bank, state):
+        (carry, queue_row, *_rest) = state
+        row = jnp.clip(queue_row, 0)
+        srv_job = carry[2]
+        slot_valid = carry[4]
+        return {"jtype": bank["type"][row],
+                "frac": bank["sla_frac"][row].astype(jnp.float64),
+                "steps": bank["steps"][row].astype(jnp.float64),
+                "n_occupied": (srv_job >= 0).sum().astype(jnp.int32),
+                "n_running": slot_valid.sum().astype(jnp.int32)}
+
+    def segment(bank, params, sim_state, rng):
+        dt = et.tables["dep_size"].dtype
+        fresh = k.init_state(bank)
+
+        def scan_body(state, step_rng):
+            (carry, queue_row, ptr, next_arrival, done, completed,
+             counters) = state
+            row = jnp.clip(queue_row, 0)
+            fields = obs_fields(bank, state)
+            obs = _kernel_obs(ot, et, fields["jtype"], fields["frac"],
+                              fields["steps"], fields["n_occupied"],
+                              fields["n_running"])
+            logits, value = model.apply(params, obs)
+            action = jax.random.categorical(step_rng,
+                                            logits).astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits)[action]
+
+            new_carry, (reward, accept, cause, jct) = k.decision(
+                bank, carry, action, row)
+            accepted, blocked, ret = counters
+            counters2 = (accepted + accept.astype(jnp.int32),
+                         blocked + (~accept).astype(jnp.int32),
+                         ret + reward)
+            (carry3, queue_row3, ptr3, next_arrival3, done3,
+             completed3) = k.advance(bank, new_carry, jnp.int32(-1), ptr,
+                                     next_arrival, done, completed)
+            ended = done3
+            state3 = (carry3, queue_row3, ptr3, next_arrival3, done3,
+                      completed3, counters2)
+            # in-kernel episode reset: a fresh run of the same bank
+            state4 = jax.tree_util.tree_map(
+                lambda f, s: jnp.where(ended, f, s), fresh, state3)
+            out = {"action": action, "logp": logp, "value": value,
+                   "reward": reward.astype(dt), "done": ended,
+                   **fields}
+            return state4, out
+
+        rngs = jax.random.split(rng, n_steps)
+        final, trace = jax.lax.scan(scan_body, sim_state, rngs)
+        return final, trace, obs_fields(bank, final)
+
+    return jax.jit(segment)
+
+
+def rebuild_obs_batch(et: EpisodeTables, ot: dict, fields: dict):
+    """Host-side exact reconstruction of the observations the kernel saw,
+    from the compact trace fields (any leading batch shape).
+
+    Implemented as `jax.vmap(_kernel_obs)` over the flattened fields —
+    the ONE source of truth for the obs math — so the re-forward
+    reproduces the in-kernel logits bit-for-bit under either precision
+    mode by construction."""
+    import jax
+    import jax.numpy as jnp
+
+    jtype = np.asarray(fields["jtype"])
+    shape = jtype.shape
+
+    def one(t, f, s, o, r):
+        return _kernel_obs(ot, et, t, f, s, o, r)
+
+    flat = [jnp.asarray(np.asarray(fields[k]).reshape(-1))
+            for k in ("jtype", "frac", "steps", "n_occupied", "n_running")]
+    obs = jax.jit(jax.vmap(one))(*flat)
+    return {k: np.asarray(v).reshape(shape + v.shape[1:])
+            for k, v in obs.items()}
