@@ -898,52 +898,82 @@ def _episode_kernels(et: EpisodeTables):
     eps = et.eps
     sim_end = et.sim_end
 
+    def eval_cfg(bank, carry, row, cfg):
+        """Evaluate ONE (job, degree) candidate against the live cluster
+        state: placement, dep pricing, channel check, lookahead, SLA —
+        everything a decision needs, minus the commit. XLA dead-code
+        eliminates the commit outputs when a caller (candidate pricing)
+        only reads (ok, jct)."""
+        (t, mem, srv_job, chan_occ, slot_valid, slot_t_done, slot_mem,
+         slot_servers, slot_chan) = carry
+        dt = mem.dtype
+        steps = bank["steps"][row].astype(dt)
+        other_free = srv_job < 0
+        ots, new_mem, ok_place = jax_allocate_job(
+            mem, other_free, cfg, et.tables, st, pads)
+        times, is_flow, chan, op_score, dep_score, finite_ok = \
+            jax_price_and_score(ots, cfg, et.tables, st, pads,
+                                et.comm, et.pair_channel)
+        occ_vals = chan_occ[jnp.clip(chan, 0)]
+        ok_chan = jnp.all(~is_flow | (occ_vals < 0))
+
+        from ddls_tpu.sim.jax_lookahead import jax_lookahead
+        op_valid = et.tables["op_valid"][cfg]
+        t_step, _, _, _, ok_la = jax_lookahead(
+            et.tables["op_compute"][cfg], op_valid,
+            jnp.where(op_valid, ots, -1), op_score,
+            et.tables["num_parents"][cfg], times,
+            et.tables["dep_valid"][cfg], et.tables["dep_src"][cfg],
+            et.tables["dep_dst"][cfg], et.tables["dep_mutual"][cfg],
+            is_flow, dep_score, chan[:, None],
+            num_workers=n_srv, num_channels=n_chan)
+        jct = t_step * steps
+        max_jct = (bank["sla_frac"][row].astype(dt)
+                   * et.tables["seq_compute"][cfg].astype(dt) * steps)
+        sla_ok = ~(jct > max_jct)
+        engine_ok = ok_la & finite_ok
+        srv_mask = jnp.zeros((n_srv,), bool).at[
+            jnp.clip(ots, 0)].max(op_valid & (ots >= 0))
+        chan_mask = jnp.zeros((n_chan,), bool).at[
+            jnp.clip(chan, 0)].max(is_flow)
+        return {"ok_place": ok_place, "ok_chan": ok_chan,
+                "engine_ok": engine_ok, "sla_ok": sla_ok, "jct": jct,
+                "new_mem": new_mem, "srv_mask": srv_mask,
+                "chan_mask": chan_mask}
+
+    def price_all(bank, carry, row):
+        """In-kernel candidate pricing: (placeable [n_deg], jct [n_deg])
+        for every degree column against the live cluster state — the
+        jitted counterpart of sim/candidate_pricing.py. One VMAPPED
+        evaluation over the cfg batch (cfg only feeds gathers), so the
+        traced program contains the placement/pricing/lookahead kernels
+        once, not n_deg times."""
+        jtype = bank["type"][row]
+        cfgs = jtype * n_deg + jnp.arange(n_deg, dtype=jnp.int32)
+        ev = jax.vmap(eval_cfg, in_axes=(None, None, None, 0))(
+            bank, carry, row, cfgs)
+        return (ev["ok_place"] & ev["ok_chan"] & ev["engine_ok"],
+                ev["jct"])
+
     def decision(bank, carry, action, row):
         (t, mem, srv_job, chan_occ, slot_valid, slot_t_done, slot_mem,
          slot_servers, slot_chan) = carry
         dt = mem.dtype
         jtype = bank["type"][row]
-        steps = bank["steps"][row].astype(dt)
         cfg = jtype * n_deg + deg_col[jnp.clip(action, 0)]
 
         def heavy(_):
-            other_free = srv_job < 0
-            ots, new_mem, ok_place = jax_allocate_job(
-                mem, other_free, cfg, et.tables, st, pads)
-            times, is_flow, chan, op_score, dep_score, finite_ok = \
-                jax_price_and_score(ots, cfg, et.tables, st, pads,
-                                    et.comm, et.pair_channel)
-            occ_vals = chan_occ[jnp.clip(chan, 0)]
-            ok_chan = jnp.all(~is_flow | (occ_vals < 0))
-
-            from ddls_tpu.sim.jax_lookahead import jax_lookahead
-            op_valid = et.tables["op_valid"][cfg]
-            t_step, _, _, _, ok_la = jax_lookahead(
-                et.tables["op_compute"][cfg], op_valid,
-                jnp.where(op_valid, ots, -1), op_score,
-                et.tables["num_parents"][cfg], times,
-                et.tables["dep_valid"][cfg], et.tables["dep_src"][cfg],
-                et.tables["dep_dst"][cfg], et.tables["dep_mutual"][cfg],
-                is_flow, dep_score, chan[:, None],
-                num_workers=n_srv, num_channels=n_chan)
-            jct = t_step * steps
-            max_jct = (bank["sla_frac"][row].astype(dt)
-                       * et.tables["seq_compute"][cfg].astype(dt) * steps)
-            sla_ok = ~(jct > max_jct)
-            engine_ok = ok_la & finite_ok
-            accept = ok_place & ok_chan & sla_ok & engine_ok
+            ev = eval_cfg(bank, carry, row, cfg)
+            accept = (ev["ok_place"] & ev["ok_chan"] & ev["sla_ok"]
+                      & ev["engine_ok"])
             cause = jnp.where(
-                ~ok_place, CAUSE_OP_PLACEMENT,
-                jnp.where(~ok_chan, CAUSE_DEP_PLACEMENT,
-                          jnp.where(~engine_ok, CAUSE_ENGINE,
-                                    jnp.where(~sla_ok, CAUSE_SLA,
+                ~ev["ok_place"], CAUSE_OP_PLACEMENT,
+                jnp.where(~ev["ok_chan"], CAUSE_DEP_PLACEMENT,
+                          jnp.where(~ev["engine_ok"], CAUSE_ENGINE,
+                                    jnp.where(~ev["sla_ok"], CAUSE_SLA,
                                               CAUSE_ACCEPTED))))
-            srv_mask = jnp.zeros((n_srv,), bool).at[
-                jnp.clip(ots, 0)].max(op_valid & (ots >= 0))
-            chan_mask = jnp.zeros((n_chan,), bool).at[
-                jnp.clip(chan, 0)].max(is_flow)
-            return (accept, cause.astype(jnp.int32), jct, new_mem,
-                    srv_mask, chan_mask)
+            return (accept, cause.astype(jnp.int32), ev["jct"],
+                    ev["new_mem"], ev["srv_mask"], ev["chan_mask"])
 
         def zero(_):
             return (jnp.bool_(False), jnp.int32(CAUSE_NOT_HANDLED),
@@ -1051,7 +1081,8 @@ def _episode_kernels(et: EpisodeTables):
                 (jnp.int32(0), jnp.int32(0), jnp.zeros((), dt)))
 
     return _types.SimpleNamespace(decision=decision, advance=advance,
-                                  init_state=init_state)
+                                  init_state=init_state,
+                                  price_all=price_all)
 
 
 def make_episode_fn(et: EpisodeTables):
@@ -1421,3 +1452,112 @@ def rebuild_obs_batch(et: EpisodeTables, ot: dict, fields: dict):
     obs = jax.jit(jax.vmap(one))(*flat)
     return {k: np.asarray(v).reshape(shape + v.shape[1:])
             for k, v in obs.items()}
+
+
+# =========================================================================
+# The OracleJCT heuristic running entirely in-kernel: candidate pricing,
+# action selection, decision, event clock — one dispatch per episode.
+# =========================================================================
+
+def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
+    """Jitted OracleJCT episodes: per decision, price EVERY candidate
+    degree in-kernel (`price_all`), pick the smallest degree whose priced
+    JCT meets the SLA (else the smallest-JCT placeable candidate, else
+    the smallest valid degree, else 0 — exactly
+    `envs/baselines.py:OracleJCT.compute_action`), then run the decision
+    and event clock. (bank) -> traces.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k = _episode_kernels(et)
+    degrees = jnp.asarray(np.array(et.degrees, np.int32))
+    n_deg = len(et.degrees)
+    exists = jnp.asarray(np.asarray(
+        ot["shapes_exist"])[np.asarray(et.degrees)])
+
+    def episode(bank):
+        dt = et.tables["dep_size"].dtype
+
+        def scan_body(state, _):
+            (carry, queue_row, ptr, next_arrival, done, completed,
+             counters) = state
+            t = carry[0]
+            has_job = (queue_row >= 0) & ~done
+            row = jnp.clip(queue_row, 0)
+
+            def run(_):
+                srv_job = carry[2]
+                free = et.n_srv - (srv_job >= 0).sum()
+                # the obs action mask restricted to the degree columns
+                # (envs/obs.py:action_is_valid)
+                mask = jnp.where(
+                    degrees == 1, free >= 1,
+                    (degrees <= free) & exists)
+                ok, jcts = k.price_all(bank, carry, row)
+                steps = bank["steps"][row].astype(dt)
+                # the host oracle's limit is the ORIGINAL (unpartitioned)
+                # job's max_acceptable_jct (baselines.py:143 reads the
+                # queue job), not the per-degree partitioned sums the
+                # cluster's own SLA gate uses — mirror exactly
+                max_jct = (bank["sla_frac"][row].astype(dt)
+                           * jnp.asarray(ot["orig_seq_sum"]).astype(dt)[
+                               bank["type"][row]] * steps)
+                acceptable = mask & ok & (jcts <= max_jct)
+                placeable = mask & ok
+
+                big = jnp.asarray(jnp.inf, dt)
+                # 1) smallest acceptable degree
+                first_acc = jnp.where(
+                    acceptable.any(),
+                    degrees[jnp.argmax(acceptable)], -1)
+                # 2) else smallest-JCT placeable (first minimum in degree
+                # order — strict < scan reproduces the host's min())
+                best_jct = big
+                best_deg = jnp.int32(-1)
+                for d in range(n_deg):
+                    take = placeable[d] & (jcts[d] < best_jct)
+                    best_jct = jnp.where(take, jcts[d], best_jct)
+                    best_deg = jnp.where(take, degrees[d], best_deg)
+                # 3) else smallest valid degree, else 0
+                first_valid = jnp.where(mask.any(),
+                                        degrees[jnp.argmax(mask)], 0)
+                action = jnp.where(
+                    first_acc >= 0, first_acc,
+                    jnp.where(best_deg >= 0, best_deg, first_valid)
+                ).astype(jnp.int32)
+
+                new_carry, (reward, accept, cause, jct) = k.decision(
+                    bank, carry, action, row)
+                return (new_carry, action, reward, accept, cause, jct)
+
+            def skip(_):
+                return (carry, jnp.int32(0), jnp.zeros((), dt),
+                        jnp.bool_(False), jnp.int32(-1),
+                        jnp.zeros((), dt))
+
+            (new_carry, action, reward, accept, cause, jct) = jax.lax.cond(
+                has_job, run, skip, operand=None)
+            accepted, blocked, ret = counters
+            counters2 = (accepted + (has_job & accept),
+                         blocked + (has_job & ~accept),
+                         ret + jnp.where(has_job, reward, 0.0))
+            queue_row2 = jnp.where(has_job, -1, queue_row)
+            (carry3, queue_row3, ptr3, next_arrival3, done3,
+             completed3) = k.advance(bank, new_carry, queue_row2, ptr,
+                                     next_arrival, done, completed)
+            out = (action, reward, accept, cause, jct, t, has_job)
+            return ((carry3, queue_row3, ptr3, next_arrival3, done3,
+                     completed3, counters2), out)
+
+        state0 = k.init_state(bank)
+        n_steps = bank["type"].shape[0]
+        final, trace = jax.lax.scan(scan_body, state0, None,
+                                    length=n_steps)
+        counters = final[6]
+        return {"trace": trace, "accepted": counters[0],
+                "blocked": counters[1], "ret": counters[2],
+                "completed": final[5], "t": final[0][0],
+                "done": final[4]}
+
+    return jax.jit(episode)
