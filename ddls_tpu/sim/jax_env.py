@@ -1165,12 +1165,7 @@ def build_obs_tables(env, et: EpisodeTables) -> dict:
     """
     gen = env.cluster.jobs_generator
     obs_fn = env.observation_function
-    if getattr(obs_fn, "include_candidate_prices", False):
-        # price features are decision-time values of the queued job; the
-        # static per-type template cannot carry them and _kernel_obs does
-        # not rebuild them (yet) — refuse rather than silently mis-slice
-        raise ValueError("the jitted episode does not support "
-                         "obs_include_candidate_prices")
+    with_prices = bool(getattr(obs_fn, "include_candidate_prices", False))
     params = gen.jobs_params
 
     proto_by_model = {}
@@ -1181,7 +1176,14 @@ def build_obs_tables(env, et: EpisodeTables) -> dict:
     for model in et.types:
         job = proto_by_model[model]
         obs = obs_fn.encode(job, env)
-        rows.append({k: np.asarray(v) for k, v in obs.items()})
+        obs = {k: np.asarray(v) for k, v in obs.items()}
+        if with_prices:
+            # the template's baked price block is decision-time data of
+            # whatever job was queued at encode time — drop it; the
+            # kernel rebuilds the block from its own in-kernel pricing
+            obs["graph_features"] = obs["graph_features"][
+                :-(et.max_action + 1)]
+        rows.append(obs)
 
     def stack(key):
         return np.stack([r[key] for r in rows])
@@ -1212,11 +1214,26 @@ def build_obs_tables(env, et: EpisodeTables) -> dict:
         "shapes_exist": np.array(
             [bool(block_shapes_for(factor_pairs(a), et.st.ramp_shape))
              for a in range(et.max_action + 1)], bool),
+        "with_prices": with_prices,
     }
 
 
+def _kernel_action_mask(ot: dict, et: EpisodeTables, n_occupied):
+    """The obs action mask (envs/obs.py:action_is_valid) from occupancy:
+    0 always; 1 needs a free worker; even a needs a <= free workers AND
+    an existing block shape. The ONE in-kernel statement of the rule."""
+    import jax.numpy as jnp
+
+    free = et.n_srv - n_occupied
+    a = jnp.arange(et.max_action + 1)
+    exists = jnp.asarray(ot["shapes_exist"])
+    return ((a == 0)
+            | ((a == 1) & (free >= 1))
+            | ((a > 1) & (a % 2 == 0) & (a <= free) & exists))
+
+
 def _kernel_obs(ot: dict, et: EpisodeTables, jtype, frac, steps,
-                n_occupied, n_running):
+                n_occupied, n_running, price_feats=None):
     """Rebuild the exact host observation for one queued job inside jit.
 
     Dynamic entries are computed with the host's formulas (f64) and the
@@ -1239,17 +1256,16 @@ def _kernel_obs(ot: dict, et: EpisodeTables, jtype, frac, steps,
     gf = gf.at[15].set(n_occupied / n_srv)
     gf = gf.at[16].set(n_running / n_srv)
 
-    # action mask (envs/obs.py:action_is_valid): 0 always; 1 needs a free
-    # worker; even a needs a <= free workers AND an existing block shape
-    free = n_srv - n_occupied
-    a = jnp.arange(et.max_action + 1)
-    exists = jnp.asarray(ot["shapes_exist"])
-    mask = ((a == 0)
-            | ((a == 1) & (free >= 1))
-            | ((a > 1) & (a % 2 == 0) & (a <= free) & exists))
+    mask = _kernel_action_mask(ot, et, n_occupied)
     n_feat = jnp.asarray(ot["graph_features"]).shape[1]
     gf17 = jnp.clip(gf[:n_feat - mask.shape[0]], 0.0, 1.0)
-    gf = jnp.concatenate([gf17, mask.astype(jnp.float64)])
+    parts = [gf17, mask.astype(jnp.float64)]
+    if ot.get("with_prices"):
+        if price_feats is None:
+            raise ValueError("obs tables carry price features; pass "
+                             "price_feats (envs/obs.py:_price_features)")
+        parts.append(price_feats.astype(jnp.float64))
+    gf = jnp.concatenate(parts)
 
     return {
         "action_set": jnp.arange(et.max_action + 1, dtype=jnp.int32),
@@ -1293,11 +1309,34 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
                 # cond so dead scan steps after episode end cost nothing
                 srv_job = carry[2]
                 slot_valid = carry[4]
+                price_feats = None
+                if ot.get("with_prices"):
+                    # in-kernel candidate pricing as observation features
+                    # (envs/obs.py:_price_features: min(jct/limit, 2)/2,
+                    # 1.0 for unpriceable; the host prices only
+                    # mask-valid degrees). Limit multiplies in the HOST's
+                    # association order frac * (sum * steps)
+                    # (demands/job.py:55,273) — bit-equal features
+                    frac64 = bank["sla_frac"][row].astype(jnp.float64)
+                    steps64 = bank["steps"][row].astype(jnp.float64)
+                    ok, jcts = k.price_all(bank, carry, row)
+                    limit = jnp.maximum(
+                        frac64 * (jnp.asarray(ot["orig_seq_sum"])[
+                            bank["type"][row]] * steps64), 1e-30)
+                    degs = jnp.asarray(np.array(et.degrees, np.int32))
+                    dmask = _kernel_action_mask(
+                        ot, et, (srv_job >= 0).sum())[degs]
+                    vals = jnp.minimum(jcts.astype(jnp.float64) / limit,
+                                       2.0) / 2.0
+                    price_feats = jnp.ones(
+                        (et.max_action + 1,), jnp.float64).at[degs].set(
+                        jnp.where(ok & dmask, vals, 1.0))
                 obs = _kernel_obs(
                     ot, et, bank["type"][row],
                     bank["sla_frac"][row].astype(jnp.float64),
                     bank["steps"][row].astype(jnp.float64),
-                    (srv_job >= 0).sum(), slot_valid.sum())
+                    (srv_job >= 0).sum(), slot_valid.sum(),
+                    price_feats=price_feats)
                 logits, value = model.apply(params, obs)
                 if greedy:
                     action = jnp.argmax(logits).astype(jnp.int32)
@@ -1373,6 +1412,12 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
     import jax
     import jax.numpy as jnp
 
+    if ot.get("with_prices"):
+        raise ValueError(
+            "segment collection does not support price-feature "
+            "observations (the compact PPO trace carries no pricing "
+            "state); build obs tables from an env without "
+            "obs_include_candidate_prices")
     k = _episode_kernels(et)
 
     def obs_fields(bank, state):
@@ -1441,6 +1486,10 @@ def rebuild_obs_batch(et: EpisodeTables, ot: dict, fields: dict):
     import jax
     import jax.numpy as jnp
 
+    if ot.get("with_prices"):
+        raise ValueError(
+            "rebuild_obs_batch does not support price-feature "
+            "observations (the compact trace carries no pricing state)")
     jtype = np.asarray(fields["jtype"])
     shape = jtype.shape
 
@@ -1473,8 +1522,6 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
     k = _episode_kernels(et)
     degrees = jnp.asarray(np.array(et.degrees, np.int32))
     n_deg = len(et.degrees)
-    exists = jnp.asarray(np.asarray(
-        ot["shapes_exist"])[np.asarray(et.degrees)])
 
     def episode(bank):
         dt = et.tables["dep_size"].dtype
@@ -1488,12 +1535,9 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
 
             def run(_):
                 srv_job = carry[2]
-                free = et.n_srv - (srv_job >= 0).sum()
                 # the obs action mask restricted to the degree columns
-                # (envs/obs.py:action_is_valid)
-                mask = jnp.where(
-                    degrees == 1, free >= 1,
-                    (degrees <= free) & exists)
+                mask = _kernel_action_mask(
+                    ot, et, (srv_job >= 0).sum())[degrees]
                 ok, jcts = k.price_all(bank, carry, row)
                 steps = bank["steps"][row].astype(dt)
                 # the host oracle's limit is the ORIGINAL (unpartitioned)
@@ -1501,8 +1545,8 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
                 # queue job), not the per-degree partitioned sums the
                 # cluster's own SLA gate uses — mirror exactly
                 max_jct = (bank["sla_frac"][row].astype(dt)
-                           * jnp.asarray(ot["orig_seq_sum"]).astype(dt)[
-                               bank["type"][row]] * steps)
+                           * (jnp.asarray(ot["orig_seq_sum"]).astype(dt)[
+                               bank["type"][row]] * steps))
                 acceptable = mask & ok & (jcts <= max_jct)
                 placeable = mask & ok
 

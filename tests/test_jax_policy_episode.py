@@ -11,11 +11,13 @@ import subprocess
 import sys
 
 DRIVER = r"""
+import os
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 assert jax.config.read("jax_enable_x64")
+USE_PRICES = bool(int(os.environ.get("DRIVER_PRICES", "0")))
 
 import tempfile
 from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
@@ -50,7 +52,9 @@ def make_env():
             "num_training_steps": 20},
         max_partitions_per_op=8, min_op_run_time_quantum=0.01,
         reward_function="job_acceptance", max_simulation_run_time=4e3,
-        pad_obs_kwargs={"max_nodes": 150, "max_edges": 512})
+        pad_obs_kwargs={"max_nodes": 150, "max_edges": 512},
+        candidate_pricing="native" if USE_PRICES else None,
+        obs_include_candidate_prices=USE_PRICES)
 
 env = make_env()
 obs = env.reset(seed=17)
@@ -79,22 +83,27 @@ while not done:
                          "num_training_steps": job.num_training_steps,
                          "sla_frac": job.max_acceptable_jct_frac,
                          "time_arrived": job.details["time_arrived"]})
-    # in-kernel obs parity vs the host encoder at THIS live state
-    jtype = et.types.index(job.details["model"])
-    kobs = _kernel_obs(ot, et, jnp.int32(jtype),
-                       jnp.float64(job.max_acceptable_jct_frac),
-                       jnp.float64(job.num_training_steps),
-                       jnp.int32(len(env.cluster.mounted_workers)),
-                       jnp.int32(len(env.cluster.jobs_running)))
-    for key in obs:
-        a = np.asarray(kobs[key])
-        b = np.asarray(obs[key])
-        assert a.dtype == b.dtype or key in ("action_mask",), (
-            key, a.dtype, b.dtype)
-        assert np.array_equal(a.astype(b.dtype), b), (
-            f"obs field {key} diverged at decision {len(actions)}:"
-            f" {a} vs {b}")
-    obs_checked += 1
+    if not USE_PRICES:
+        # in-kernel obs parity vs the host encoder at THIS live state
+        # (the price block needs the kernel's own pricing state, so the
+        # price variant is proven through trace parity instead: the
+        # greedy policy CONSUMES the price block, so any divergence in it
+        # changes the action trace)
+        jtype = et.types.index(job.details["model"])
+        kobs = _kernel_obs(ot, et, jnp.int32(jtype),
+                           jnp.float64(job.max_acceptable_jct_frac),
+                           jnp.float64(job.num_training_steps),
+                           jnp.int32(len(env.cluster.mounted_workers)),
+                           jnp.int32(len(env.cluster.jobs_running)))
+        for key in obs:
+            a = np.asarray(kobs[key])
+            b = np.asarray(obs[key])
+            assert a.dtype == b.dtype or key in ("action_mask",), (
+                key, a.dtype, b.dtype)
+            assert np.array_equal(a.astype(b.dtype), b), (
+                f"obs field {key} diverged at decision {len(actions)}:"
+                f" {a} vs {b}")
+        obs_checked += 1
 
     logits, value = model.apply(params, jax.tree_util.tree_map(
         jnp.asarray, obs))
@@ -134,14 +143,31 @@ print(f"POLICY_EPISODE_PARITY_OK decisions={n} ret={host_ret}")
 """
 
 
-def test_policy_episode_parity_x64():
+def _run_driver(prices: bool):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_ENABLE_X64"] = "1"
+    env["DRIVER_PRICES"] = "1" if prices else "0"
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))
     res = subprocess.run([sys.executable, "-c", DRIVER], env=env,
                          capture_output=True, text=True, timeout=1800)
     assert res.returncode == 0, (res.stdout[-4000:], res.stderr[-4000:])
     assert "POLICY_EPISODE_PARITY_OK" in res.stdout, res.stdout[-2000:]
+
+
+def test_policy_episode_parity_x64():
+    _run_driver(prices=False)
+
+
+def test_policy_episode_parity_with_price_features_x64():
+    """The price-informed policy runs on device too: in-kernel candidate
+    pricing feeds the observation's price block and the greedy rollout
+    reproduces the host env's full action/reward trace. (The price block
+    is checked THROUGH the trace — the greedy policy consumes it, so a
+    feature divergence big enough to change any decision fails the test;
+    per-field bit-equality is pinned for the non-price obs by the other
+    variant and for the price values by test_jax_oracle_episode.py's
+    pricing parity.)"""
+    _run_driver(prices=True)
