@@ -44,20 +44,71 @@ PEAK_FLOPS_BY_DEVICE_KIND = {
 }
 
 
+def _cost_flops(cost) -> float | None:
+    if isinstance(cost, list):  # one dict per device program
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    flops = float(cost.get("flops", 0.0))
+    return flops if flops > 0 else None
+
+
 def update_cost_analysis(jitted, *args) -> float | None:
-    """FLOPs of one update step via XLA cost analysis on the *lowered*
-    (uncompiled) computation — tracing is cheap, and avoiding ``.compile()``
-    avoids a second full XLA compile of the scanned SGD update, which would
-    eat minutes of the driver's bench budget. Returns None where the
-    backend doesn't support cost analysis."""
+    """GLOBAL (pre-partitioning) FLOPs of one update step via XLA cost
+    analysis on the *lowered* (uncompiled) computation — tracing is cheap,
+    and avoiding ``.compile()`` avoids a second full XLA compile of the
+    scanned SGD update, which would eat minutes of the driver's bench
+    budget. Returns None where the backend doesn't support the lowered
+    analysis (axon does not — see ``compiled_cost_analysis``)."""
     try:
-        cost = jitted.lower(*args).cost_analysis()
-        if isinstance(cost, list):  # one dict per device program
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        return flops if flops > 0 else None
+        return _cost_flops(jitted.lower(*args).cost_analysis())
     except Exception:
         return None
+
+
+def compiled_cost_analysis(jitted, *args, n_dev: int,
+                           deadline_s: float,
+                           payload_on_timeout: dict) -> float | None:
+    """Fallback FLOPs via ``.compile().cost_analysis()`` — the only path
+    the axon (tunnelled TPU) backend supports. Two hazards handled here:
+
+    - the compiled analysis reports the PER-DEVICE partitioned program's
+      FLOPs, not the global computation's, so the result is scaled by
+      ``n_dev`` to match what ``update_cost_analysis`` returns;
+    - the in-process compile dispatches through the tunnel, which can
+      wedge for hours (CLAUDE.md), and a wedged compile cannot be
+      interrupted from Python — so a watchdog thread emits
+      ``payload_on_timeout`` (the measurement gathered so far, minus MFU)
+      and hard-exits if the deadline passes, keeping the driver's
+      one-JSON-line contract intact. Call this only AFTER the timed
+      epochs are complete.
+    """
+    import threading
+
+    emitted = threading.Lock()
+    emit_finished = threading.Event()
+
+    def _watchdog():
+        if not done.wait(deadline_s):
+            if emitted.acquire(blocking=False):
+                emit(payload_on_timeout)
+                emit_finished.set()
+                os._exit(0)
+
+    done = threading.Event()
+    threading.Thread(target=_watchdog, daemon=True).start()
+    try:
+        flops = _cost_flops(jitted.lower(*args).compile().cost_analysis())
+    except Exception:
+        flops = None
+    done.set()
+    if not emitted.acquire(blocking=False):
+        # watchdog won the race at the deadline boundary: wait for its
+        # emit to actually hit stdout before dying (os._exit in THIS
+        # thread would kill the process before the line lands)
+        emit_finished.wait(30)
+        os._exit(0)
+    return flops * n_dev if flops is not None else None
 
 
 def emit(payload: dict) -> None:
@@ -378,11 +429,25 @@ def run_bench(args, platform_note: str | None,
         "timed_epochs": epochs_run,
         "cores": _available_cores(),
     }
+    if platform_note:
+        payload["platform_note"] = platform_note
     # achieved FLOPs / MFU of the jitted sharded update (VERDICT round-2
     # weakness 2: "fast" must mean something on the chip, not just vs the
     # invented 240 env-steps/s denominator)
     if epochs_run and update_time[0] > 0:
         payload["update_ms"] = round(update_time[0] / epochs_run * 1e3, 2)
+        if update_flops is None and update_args is not None:
+            # axon supports only the compiled analysis; bounded + crash-safe
+            # (emits `payload` as-is and exits if the tunnel wedges), and
+            # only attempted with enough wall budget for a ~minute compile
+            headroom = (args.budget_seconds
+                        - (time.perf_counter() - process_start))
+            if headroom > 90:
+                straj, slv = update_args
+                update_flops = compiled_cost_analysis(
+                    learner._jit_train_step, state, straj, slv, rng,
+                    n_dev=n_dev, deadline_s=headroom - 30,
+                    payload_on_timeout=payload)
         if update_flops is not None:
             achieved = update_flops * epochs_run / update_time[0]
             payload["update_flops"] = update_flops
@@ -392,10 +457,11 @@ def run_bench(args, platform_note: str | None,
             # the aggregate peak of every chip the mesh spans
             peak = PEAK_FLOPS_BY_DEVICE_KIND.get(
                 getattr(dev, "device_kind", ""))
-            payload["mfu"] = (round(achieved / (peak * n_dev), 4)
+            # significant-digit rounding: this model's honest MFU is tiny
+            # (a ~2 GFLOP GNN update on a 197 TFLOP/s chip) and fixed
+            # 4-decimal rounding would report a literal 0.0
+            payload["mfu"] = (float(f"{achieved / (peak * n_dev):.3g}")
                               if peak else None)
-    if platform_note:
-        payload["platform_note"] = platform_note
     # ride the pure-simulator figure along in the same JSON line when the
     # driver budget allows (VERDICT r2 #1: report ppo AND sim modes). The
     # rider is the real --mode sim CLI (identical env sizing to a

@@ -9,8 +9,9 @@ host tick engine costs ~100 ms each at bench scale; here each candidate's
 control-plane (partition -> first-fit placement -> SRPT schedules ->
 pricing) runs on host over the array pipeline, and the tick engines
 evaluate the batch — the C++ engine per candidate (~0.2 ms, bit-exact
-f64), or ONE vmapped jitted call for the whole batch on an accelerator
-(f32, one dispatch amortises the device round-trip).
+f64; the measured default everywhere, docs/perf_round4.md), or the
+opt-in vmapped jitted call (kept for parity testing; measured ~50x
+slower through the tunnelled TPU).
 
 Every priced candidate is inserted into ``cluster.lookahead_cache`` under
 its exact memo key, so the subsequent ``env.step`` with any priced action
@@ -122,14 +123,14 @@ def price_candidate_degrees(env, degrees=None,
 def _resolve_backend(backend: str) -> str:
     if backend != "auto":
         return backend
-    try:
-        import jax
-
-        # one vmapped dispatch only beats the ~0.2 ms/candidate C++ engine
-        # when a real accelerator runs it; on CPU the native engine wins
-        return "jax" if jax.devices()[0].platform != "cpu" else "native"
-    except Exception:
-        return "native"
+    # Measured on the real tunnelled v5e (docs/perf_round4.md, VERDICT r3
+    # item 9): jax pricing averages ~1.2 s/decision through the tunnel
+    # (dispatch RTTs + a retrace per distinct candidate-batch size) vs
+    # ~23 ms for the C++ engine on host — the accelerator hypothesis the
+    # old auto rule encoded lost by ~50x, so auto is native everywhere.
+    # The jitted env (sim/jax_env.py) prices IN-kernel instead; this host
+    # helper's jax backend remains opt-in for parity tests.
+    return "native"
 
 
 def _evaluate(cluster, pending, backend: str):
