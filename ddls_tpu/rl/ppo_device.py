@@ -39,6 +39,10 @@ class DevicePPOCollector:
         # per-env initial state from each env's OWN bank (arrival clocks
         # differ across banks)
         self._state = jax.vmap(lambda b: segment_init(et, b))(banks)
+        # per-lane decision count of the in-flight episode (episodes span
+        # segment boundaries; the kernel's counters reset in-kernel at
+        # done, so length is tracked here)
+        self._ep_len = np.zeros(self.num_envs, np.int64)
 
     def collect(self, params, rng) -> Dict:
         """One [T, B] segment batch; returns the PPOLearner traj dict
@@ -69,4 +73,36 @@ class DevicePPOCollector:
             k: np.asarray(v) for k, v in next_obs.items()})
         return {"traj": traj,
                 "last_values": np.asarray(last_values, np.float32),
-                "env_steps": self.rollout_length * self.num_envs}
+                "env_steps": self.rollout_length * self.num_envs,
+                "episodes": self._harvest_episodes(trace)}
+
+    def _harvest_episodes(self, trace) -> list:
+        """Episode records at done boundaries, from the traced in-kernel
+        counters — the device counterpart of
+        `rollout.py:harvest_episode_record`. ``acceptance_rate`` /
+        ``blocking_rate`` use decided arrivals (accepted+blocked) as the
+        denominator; the host cluster divides by ALL arrivals, which also
+        counts jobs still queued when the episode ends — a small, documented
+        divergence (the kernel trace carries no arrival counter)."""
+        episodes = []
+        done = trace["done"]  # [T, B] after the caller's swap
+        T, B = done.shape
+        for t in range(T):
+            self._ep_len += 1
+            for b in np.nonzero(done[t])[0]:
+                acc = int(trace["ep_accepted"][t, b])
+                blk = int(trace["ep_blocked"][t, b])
+                com = int(trace["ep_completed"][t, b])
+                decided = acc + blk
+                episodes.append({
+                    "env_index": int(b),
+                    "episode_return": float(trace["ep_return"][t, b]),
+                    "episode_length": int(self._ep_len[b]),
+                    "num_jobs_completed": com,
+                    "num_jobs_blocked": blk,
+                    # host formulas: completed/arrived, blocked/arrived
+                    "acceptance_rate": com / decided if decided else 0.0,
+                    "blocking_rate": blk / decided if decided else 0.0,
+                })
+                self._ep_len[b] = 0
+        return episodes

@@ -127,10 +127,14 @@ def _resolve_backend(backend: str) -> str:
     # item 9): jax pricing averages ~1.2 s/decision through the tunnel
     # (dispatch RTTs + a retrace per distinct candidate-batch size) vs
     # ~23 ms for the C++ engine on host — the accelerator hypothesis the
-    # old auto rule encoded lost by ~50x, so auto is native everywhere.
+    # old auto rule encoded lost by ~50x, so auto is native everywhere
+    # the native engine exists (toolchain-less hosts fall back to jax:
+    # slow prices beat every candidate silently reading "unplaceable").
     # The jitted env (sim/jax_env.py) prices IN-kernel instead; this host
     # helper's jax backend remains opt-in for parity tests.
-    return "native"
+    from ddls_tpu.native import native_available
+
+    return "native" if native_available() else "jax"
 
 
 def _evaluate(cluster, pending, backend: str):

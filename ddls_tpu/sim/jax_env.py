@@ -874,6 +874,51 @@ def build_job_bank(et: EpisodeTables, records: Sequence[dict]) -> dict:
     return bank
 
 
+def sample_job_bank(et: EpisodeTables, env, n_jobs: int, seed: int) -> dict:
+    """A job bank SAMPLED from the env's own workload machinery — the
+    device-collection counterpart of the host cluster's arrival stream
+    (cluster.py:224: ``jobs_generator.sample_job()`` +
+    ``sample_interarrival_time()``).
+
+    The env's generator is deep-copied so its pool state (sampling-mode
+    bookkeeping, job ids) is untouched, and BOTH process-global rngs the
+    workload machinery draws from (numpy for the distributions, python's
+    ``random`` for pool shuffles on refill) are seeded then
+    snapshotted/restored around the draw, so banks are determined by
+    ``seed`` alone and building them never perturbs the host envs'
+    stochastic streams.
+
+    A ``remove``-mode pool that exhausts before ``n_jobs`` ends the bank
+    early — the host counterpart returns an infinite interarrival there
+    and the episode simply sees no further arrivals.
+    """
+    import copy
+    import random as _random
+
+    gen = copy.deepcopy(env.cluster.jobs_generator)
+    np_state = np.random.get_state()
+    py_state = _random.getstate()
+    try:
+        np.random.seed(seed)
+        _random.seed(seed ^ 0x5DEECE66D)
+        t, recs = 0.0, []
+        for _ in range(n_jobs):
+            if len(gen.sampler) == 0:
+                break
+            job = gen.sample_job()
+            recs.append({
+                "model": job.details.get("model"),
+                "num_training_steps": job.num_training_steps,
+                "sla_frac": float(job.max_acceptable_jct_frac),
+                "time_arrived": t,
+            })
+            t += float(gen.sample_interarrival_time())
+    finally:
+        np.random.set_state(np_state)
+        _random.setstate(py_state)
+    return build_job_bank(et, recs)
+
+
 def _episode_kernels(et: EpisodeTables):
     """Shared decision / event-clock / initial-state kernels for the
     replay (`make_episode_fn`) and policy (`make_policy_episode_fn`)
@@ -1463,8 +1508,13 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
             # in-kernel episode reset: a fresh run of the same bank
             state4 = jax.tree_util.tree_map(
                 lambda f, s: jnp.where(ended, f, s), fresh, state3)
+            # episode counters ride the trace so the training loop can
+            # harvest episode records at done boundaries (the reset wipes
+            # them from the carried state the very same step)
             out = {"action": action, "logp": logp, "value": value,
                    "reward": reward.astype(dt), "done": ended,
+                   "ep_accepted": counters2[0], "ep_blocked": counters2[1],
+                   "ep_return": counters2[2], "ep_completed": completed3,
                    **fields}
             return state4, out
 

@@ -45,8 +45,11 @@ _RLLIB_TO_PPO = {
 
 
 # algo_config keys consumed by the epoch loops themselves rather than the
-# per-algorithm translators (num_workers sizes the vectorised env pool)
-_LOOP_LEVEL_ALGO_KEYS = {"num_workers"}
+# per-algorithm translators (num_workers sizes the vectorised env pool;
+# device_collector flips PPO collection to the jitted in-kernel env,
+# device_bank_jobs sizes its per-lane sampled job banks)
+_LOOP_LEVEL_ALGO_KEYS = {"num_workers", "device_collector",
+                         "device_bank_jobs"}
 
 
 def _reject_unknown_algo_keys(algo_name: str, keys, known) -> None:
@@ -212,6 +215,15 @@ class RLEpochLoop:
         self.test_seed = test_seed
 
         self._configure_algo(algo_config, num_envs, rollout_length)
+        # collection backend: host vectorised envs (default) or the
+        # fully-jitted in-kernel env (rl/ppo_device.py) — one device
+        # dispatch per [T, B] segment instead of T round-trips. Parsed
+        # here (not in _configure_algo, which subclasses replace) so every
+        # algo sees the key; loops whose collection cannot run in-kernel
+        # (DQN, ES) reject it loudly in their _build_learner.
+        self.device_collector = bool(
+            (algo_config or {}).get("device_collector", False))
+        self.device_bank_jobs = (algo_config or {}).get("device_bank_jobs")
 
         # Multi-host: each process must collect DIFFERENT rollouts (its
         # shard of the global batch), so env seeds and the action-sampling
@@ -221,7 +233,14 @@ class RLEpochLoop:
         self._collect_seed = self.seed + jax.process_index() * 100_003
 
         seed_everything(self.seed)
-        if use_parallel_envs == "auto":
+        host_pool_size = self.num_envs
+        if self.device_collector:
+            # collection runs in-kernel; the host side only needs ONE
+            # in-process env as the obs/param/episode-tables template
+            # (evaluation builds its own envs via make_eval_env)
+            use_parallel_envs = False
+            host_pool_size = 1
+        elif use_parallel_envs == "auto":
             # subprocess env workers only pay off with real cores to run on
             use_parallel_envs = available_cores() > 1
         if use_parallel_envs:
@@ -232,9 +251,9 @@ class RLEpochLoop:
         else:
             self.vec_env = VectorEnv(
                 [lambda: self.env_cls(**self.env_config)
-                 for _ in range(self.num_envs)],
+                 for _ in range(host_pool_size)],
                 seeds=[self._collect_seed + i
-                       for i in range(self.num_envs)])
+                       for i in range(host_pool_size)])
         self.vec_env.reset()
 
         template_env = getattr(self.vec_env, "envs", [None])[0]
@@ -301,9 +320,55 @@ class RLEpochLoop:
 
         self.learner = self._make_learner()
         self.state = self.learner.init_state(self.params)
+        if getattr(self, "device_collector", False):
+            self.collector = self._make_device_collector()
+            return
         self.collector = RolloutCollector(self.vec_env, self.learner,
                                           self.rollout_length)
         self.collector._needs_reset = False  # env already reset in __init__
+
+    def _make_device_collector(self):
+        """The jitted-env collection path (algo_config
+        ``device_collector: true``): per-lane job banks sampled from the
+        env's own workload distributions, episodes stepped entirely
+        in-kernel. Serves every loop that consumes the shared traj dict
+        (ppo, impala, pg). Requires the canonical-RAMP jitted env
+        (sim/jax_env.py) and a priceless observation."""
+        import jax.numpy as jnp
+
+        from ddls_tpu.rl.ppo_device import DevicePPOCollector
+        from ddls_tpu.sim.jax_env import (build_episode_tables,
+                                          build_obs_tables, sample_job_bank)
+
+        env0 = self.vec_env.envs[0]
+        et = build_episode_tables(env0)
+        ot = build_obs_tables(env0, et)
+        if self.device_bank_jobs:
+            n_jobs = int(self.device_bank_jobs)
+        else:
+            # enough arrivals to cover the sim horizon with ~10% slack
+            # (an exhausted bank would end episodes early: arrival_t=inf)
+            msrt = float(env0.max_simulation_run_time)
+            if not np.isfinite(msrt):
+                raise ValueError(
+                    "device_collector with an unbounded "
+                    "max_simulation_run_time needs an explicit "
+                    "algo_config device_bank_jobs")
+            rng_state = np.random.get_state()
+            try:
+                np.random.seed(self.seed + 31)
+                ias = [env0.cluster.jobs_generator.interarrival_dist
+                       .sample() for _ in range(100)]
+            finally:
+                np.random.set_state(rng_state)
+            n_jobs = int(msrt / max(float(np.mean(ias)), 1e-9) * 1.1) + 10
+        banks = [sample_job_bank(et, env0, n_jobs,
+                                 self._collect_seed + 7559 * i + 17)
+                 for i in range(self.num_envs)]
+        stacked = {k: jnp.asarray(np.stack([b[k] for b in banks]))
+                   for k in banks[0]}
+        return DevicePPOCollector(et, ot, self.model, stacked,
+                                  self.rollout_length)
 
     # ----------------------------------------------------------------- epoch
     def _split_rng(self):
@@ -579,6 +644,11 @@ class ApexDQNEpochLoop(RLEpochLoop):
     def _build_learner(self) -> None:
         from ddls_tpu.rl.dqn import ApexDQNLearner, PrioritizedReplayBuffer
 
+        if self.device_collector:
+            raise ValueError(
+                "device_collector is not supported for apex_dqn: replay "
+                "insertion + epsilon schedules step the host envs (use "
+                "ppo/impala/pg, or rl/es_device.py for on-device ES)")
         cfg = self.dqn_cfg
         self.learner = ApexDQNLearner(self.apply_fn, cfg, self.mesh)
         self.state = self.learner.init_state(self.params)
@@ -798,6 +868,11 @@ class ESEpochLoop(RLEpochLoop):
 
         self.learner = ESLearner(self.apply_fn, self.es_cfg, self.mesh,
                                  population=self.num_envs)
+        if self.device_collector:
+            raise ValueError(
+                "device_collector is not supported for es (population "
+                "fitness steps the host envs; the fully on-device ES "
+                "path is rl/es_device.py:train_es_on_device)")
         self.state = self.learner.init_state(self.params)
         self.collector = None
 

@@ -249,3 +249,38 @@ def test_batched_evaluation_runs_all_episodes(dataset_dir, tmp_path):
     assert [r["episode_return"] for r in batch] == (
         [r["episode_return"] for r in again])
     loop.close()
+
+
+def test_device_collector_epoch_loop(dataset_dir, tmp_path):
+    """algo_config device_collector=true: collection runs in the jitted
+    env (rl/ppo_device.py) while eval/checkpointing stay on the host
+    surface — the PPO-on-device product path."""
+    loop = _tiny_epoch_loop(
+        dataset_dir, tmp_path,
+        algo_config={"train_batch_size": 16, "sgd_minibatch_size": 8,
+                     "num_sgd_iter": 2, "num_workers": 2,
+                     "device_collector": True})
+    from ddls_tpu.rl.ppo_device import DevicePPOCollector
+
+    assert isinstance(loop.collector, DevicePPOCollector)
+    r1 = loop.run()
+    assert r1["env_steps_this_iter"] == 8
+    assert np.isfinite(r1["learner"]["total_loss"])
+    # banks are per-lane distinct (sampled from the env's own workload
+    # machinery with lane-offset seeds; arrival times are Fixed here, so
+    # distinctness shows in the sampled job-type sequences)
+    b = loop.collector.banks
+    assert not np.array_equal(np.asarray(b["type"][0]),
+                              np.asarray(b["type"][1]))
+    # episodes eventually complete in-kernel and surface as records
+    n_eps = 0
+    for _ in range(60):
+        r = loop.run()
+        n_eps += len(r.get("episodes") or [])
+        if n_eps:
+            break
+    assert n_eps >= 1
+    # host evaluation surface still works alongside device collection
+    ev = loop.evaluate(num_episodes=1, seed=5)
+    assert "episode_reward_mean" in ev
+    loop.close()
