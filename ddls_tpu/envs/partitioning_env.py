@@ -85,9 +85,10 @@ class RampJobPartitioningEnvironment:
         # the chosen action's cluster.step lookahead is a cache hit. The
         # jax backend batches all candidates into ONE vmapped dispatch
         # (f32 — results carry f32 rounding into the memo cache, same
-        # trade as use_jax_lookahead); "auto" uses jax only on a real
-        # accelerator and the bit-exact C++ engine otherwise
-        # (docs/jax_lookahead_gonogo.md point 2).
+        # trade as use_jax_lookahead); "auto" is the bit-exact C++ engine
+        # wherever it exists — measured 50x faster than the tunnelled-TPU
+        # jax path (docs/perf_round4.md) — with jax as the toolchain-less
+        # fallback.
         self.candidate_pricing = candidate_pricing
         self.candidate_prices: dict = {}
         self.name = name

@@ -1,0 +1,67 @@
+"""ES trained entirely on device, on the real v5e: bench-scale env
+(32-server RAMP, degree-8 action space, loaded ia-50 regime),
+population 8 (the vmap width the tunnel's remote_compile accepts)."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+from bench import _make_dataset, make_env_kwargs  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.models.policy import GNNPolicy
+    from ddls_tpu.parallel.mesh import make_mesh
+    from ddls_tpu.rl.es import ESConfig, ESLearner
+    from ddls_tpu.rl.es_device import train_es_on_device
+    from ddls_tpu.sim.jax_env import (build_episode_tables, build_job_bank,
+                                      build_obs_tables, sample_job_bank)
+
+    kwargs = make_env_kwargs(_make_dataset())
+    kwargs["jobs_config"]["job_interarrival_time_dist"]["val"] = 50.0
+    kwargs["jobs_config"]["num_training_steps"] = 20
+    kwargs["max_simulation_run_time"] = 2e4
+    kwargs["max_partitions_per_op"] = 8
+    env = RampJobPartitioningEnvironment(**kwargs)
+    obs = env.reset(seed=0)
+    et = build_episode_tables(env)
+    ot = build_obs_tables(env, et)
+    model = GNNPolicy(n_actions=len(env.action_set))
+    params = model.init(jax.random.PRNGKey(1),
+                        jax.tree_util.tree_map(jnp.asarray, obs))
+    learner = ESLearner(lambda p, o: model.apply(p, o),
+                        ESConfig(stepsize=0.02, noise_stdev=0.05),
+                        make_mesh(1), population=8)
+
+    def sample_bank(gen):
+        return {k: jnp.asarray(v)
+                for k, v in sample_job_bank(et, env, 420,
+                                            seed=5000 + gen).items()}
+
+    t0 = time.perf_counter()
+    final_params, history = train_es_on_device(
+        et, ot, model, learner, params, sample_bank,
+        n_generations=15, seed=0, verbose=True)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "generations": len(history),
+        "population": 8,
+        "wall_s": round(wall, 1),
+        "gen_s_mean_incl_compile": round(wall / len(history), 1),
+        "fitness_first3": [round(h["fitness_mean"], 1)
+                           for h in history[:3]],
+        "fitness_last3": [round(h["fitness_mean"], 1)
+                          for h in history[-3:]],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Held-out evaluation of the mixed-load price-feature PPO checkpoint.
+
+Modes:
+  seeds20  — the round-4 §1 protocol: fixed ia-50 env_load32, seeds
+             1799 + 7001..7019, greedy policy; writes the new column to
+             out_csv and prints paired stats against the baseline
+             columns of docs/results_round4/seeds20.csv.
+  loadsweep — per-decision means at ia ∈ {30,50,80,120,200}, seeds
+             7005..7007 (the round-4 §3 protocol).
+
+Usage: python eval_price_ppo.py <checkpoint_dir> <mode> <out_csv>
+"""
+import csv
+import os
+import sys
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+
+from ddls_tpu.config import load_config  # noqa: E402
+from ddls_tpu.train import RLEvalLoop, make_epoch_loop  # noqa: E402
+from train_from_config import build_epoch_loop_kwargs  # noqa: E402
+
+CONFIG_PATH = os.path.join(_ROOT, "scripts", "ramp_job_partitioning_configs")
+
+
+def build_loop(ia: float):
+    overrides = [
+        "env_config=env_load32",
+        "env_config.candidate_pricing=auto",
+        "env_config.obs_include_candidate_prices=true",
+        ("env_config.jobs_config.job_interarrival_time_dist._target_="
+         "ddls_tpu.demands.distributions.Fixed"),
+        f"env_config.jobs_config.job_interarrival_time_dist.val={ia}",
+    ]
+    cfg = load_config(CONFIG_PATH, "rllib_config", overrides)
+    kwargs = build_epoch_loop_kwargs(cfg)
+    kwargs["num_envs"] = 1
+    kwargs["rollout_length"] = 1
+    kwargs["evaluation_interval"] = None
+    return make_epoch_loop("ppo", **kwargs)
+
+
+def main():
+    if len(sys.argv) != 4:
+        raise SystemExit(__doc__)
+    ckpt, mode, out_csv = sys.argv[1], sys.argv[2], sys.argv[3]
+    if mode == "seeds20":
+        seeds = [1799] + list(range(7001, 7020))
+        loop = build_loop(50.0)
+        ev = RLEvalLoop(loop)
+        rows = []
+        for i, s in enumerate(seeds):
+            r = ev.run(checkpoint_path=ckpt if i == 0 else None, seed=s)
+            rec = r["episode"]
+            rows.append((s, rec["episode_return"], rec["episode_length"]))
+            print(f"seed {s}: return {rec['episode_return']:.1f} "
+                  f"len {rec['episode_length']}", flush=True)
+        loop.close()
+        with open(out_csv, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["seed", "ppo_price_mixed", "episode_length"])
+            w.writerows(rows)
+        vals = {s: v for s, v, _ in rows}
+        base = {}
+        with open(os.path.join(_ROOT, "docs", "results_round4", "seeds20.csv")) as f:
+            for row in csv.DictReader(f):
+                base[int(row["seed"])] = {k: float(v)
+                                          for k, v in row.items()}
+        import scipy.stats as st
+        arr = np.array([vals[s] for s in seeds])
+        print(f"ppo_price_mixed: mean {arr.mean():.2f} sd {arr.std(ddof=1):.2f} "
+              f"sem {arr.std(ddof=1)/np.sqrt(len(arr)):.2f}")
+        for col in ("apex_dqn", "ppo", "oracle_jct", "acceptable_jct"):
+            d = np.array([vals[s] - base[s][col] for s in seeds])
+            t = d.mean() / (d.std(ddof=1) / np.sqrt(len(d)))
+            p = 2 * (1 - st.t.cdf(abs(t), len(d) - 1))
+            hw = st.t.ppf(0.975, len(d) - 1) * d.std(ddof=1) / np.sqrt(len(d))
+            print(f"price_mixed - {col}: {d.mean():+.2f} "
+                  f"[{d.mean()-hw:+.2f}, {d.mean()+hw:+.2f}] p={p:.3f}")
+    elif mode == "loadsweep":
+        rows = []
+        for ia in (30.0, 50.0, 80.0, 120.0, 200.0):
+            loop = build_loop(ia)
+            ev = RLEvalLoop(loop)
+            pds = []
+            for j, s in enumerate((7005, 7006, 7007)):
+                # each load rebuilds the loop: restore into each one
+                r = ev.run(checkpoint_path=ckpt if j == 0 else None, seed=s)
+                rec = r["episode"]
+                pds.append(rec["episode_return"]
+                           / max(rec["episode_length"], 1))
+            loop.close()
+            rows.append((ia, round(float(np.mean(pds)), 3),
+                         [round(x, 3) for x in pds]))
+            print(f"ia {ia}: per-decision mean {np.mean(pds):.3f} "
+                  f"({pds})", flush=True)
+        with open(out_csv, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["interarrival", "per_decision_mean", "per_seed"])
+            w.writerows(rows)
+        print("sweep mean across loads:",
+              round(float(np.mean([r[1] for r in rows])), 3))
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()
