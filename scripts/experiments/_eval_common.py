@@ -1,0 +1,39 @@
+"""Shared plumbing for the experiment eval scripts: repo-root path
+bootstrap and the price-feature eval-loop builder (env_load32 with
+candidate pricing + price observations and a Fixed interarrival)."""
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+_SCRIPTS = os.path.join(_ROOT, "scripts")
+if _SCRIPTS not in sys.path:
+    sys.path.insert(0, _SCRIPTS)
+
+CONFIG_PATH = os.path.join(_SCRIPTS, "ramp_job_partitioning_configs")
+
+
+def build_price_eval_loop(ia: float, extra_overrides=()):
+    """A 1-env eval-shaped PPO epoch loop on the price-feature
+    env_load32 surface at Fixed interarrival ``ia``."""
+    from ddls_tpu.config import load_config
+    from ddls_tpu.train import make_epoch_loop
+    from train_from_config import build_epoch_loop_kwargs
+
+    overrides = [
+        "env_config=env_load32",
+        "env_config.candidate_pricing=auto",
+        "env_config.obs_include_candidate_prices=true",
+        ("env_config.jobs_config.job_interarrival_time_dist._target_="
+         "ddls_tpu.demands.distributions.Fixed"),
+        f"env_config.jobs_config.job_interarrival_time_dist.val={ia}",
+        *extra_overrides,
+    ]
+    cfg = load_config(CONFIG_PATH, "rllib_config", overrides)
+    kwargs = build_epoch_loop_kwargs(cfg)
+    kwargs["num_envs"] = 1
+    kwargs["rollout_length"] = 1
+    kwargs["evaluation_interval"] = None
+    return make_epoch_loop("ppo", **kwargs)

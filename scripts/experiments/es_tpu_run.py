@@ -6,8 +6,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 from bench import _make_dataset, make_env_kwargs  # noqa: E402
 
@@ -21,7 +19,7 @@ def main():
     from ddls_tpu.parallel.mesh import make_mesh
     from ddls_tpu.rl.es import ESConfig, ESLearner
     from ddls_tpu.rl.es_device import train_es_on_device
-    from ddls_tpu.sim.jax_env import (build_episode_tables, build_job_bank,
+    from ddls_tpu.sim.jax_env import (build_episode_tables,
                                       build_obs_tables, sample_job_bank)
 
     kwargs = make_env_kwargs(_make_dataset())

@@ -16,32 +16,9 @@ import sys
 
 import numpy as np
 
-_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-sys.path.insert(0, _ROOT)
-sys.path.insert(0, os.path.join(_ROOT, "scripts"))
+from _eval_common import _ROOT, build_price_eval_loop as build_loop  # noqa: E402,F401
 
-from ddls_tpu.config import load_config  # noqa: E402
-from ddls_tpu.train import RLEvalLoop, make_epoch_loop  # noqa: E402
-from train_from_config import build_epoch_loop_kwargs  # noqa: E402
-
-CONFIG_PATH = os.path.join(_ROOT, "scripts", "ramp_job_partitioning_configs")
-
-
-def build_loop(ia: float):
-    overrides = [
-        "env_config=env_load32",
-        "env_config.candidate_pricing=auto",
-        "env_config.obs_include_candidate_prices=true",
-        ("env_config.jobs_config.job_interarrival_time_dist._target_="
-         "ddls_tpu.demands.distributions.Fixed"),
-        f"env_config.jobs_config.job_interarrival_time_dist.val={ia}",
-    ]
-    cfg = load_config(CONFIG_PATH, "rllib_config", overrides)
-    kwargs = build_epoch_loop_kwargs(cfg)
-    kwargs["num_envs"] = 1
-    kwargs["rollout_length"] = 1
-    kwargs["evaluation_interval"] = None
-    return make_epoch_loop("ppo", **kwargs)
+from ddls_tpu.train import RLEvalLoop  # noqa: E402
 
 
 def main():

@@ -1,0 +1,88 @@
+"""Policy transfer across topology sizes (round-5-notes item 5).
+
+Evaluates a trained price-feature checkpoint across RAMP sizes
+8/32/72/128 servers at constant per-server load (the round-4 scaling
+protocol: interarrival 200/50/22.2/12.5, 2 held-out seeds per point),
+and prints its returns next to the round-4 scaling.csv baselines
+(AcceptableJCT / SiPML / obs-only 32-trained PPO).
+
+The hypothesis under test: candidate-price features are SIZE-INVARIANT
+(a priced JCT/SLA ratio means the same thing on any cluster), so a
+price-informed policy should not suffer the obs-only policy's 72/128
+collapse (scaling.md item 3).
+
+Usage: python eval_size_transfer.py <checkpoint_dir> <out_csv>
+"""
+import csv
+import os
+import sys
+
+import numpy as np
+
+from _eval_common import _ROOT, build_price_eval_loop  # noqa: E402
+
+from ddls_tpu.train import RLEvalLoop  # noqa: E402
+
+# (servers, comm groups, racks/group, servers/rack, interarrival)
+SIZES = [(8, 2, 2, 2, 200.0), (32, 4, 4, 2, 50.0),
+         (72, 6, 6, 2, 22.2), (128, 8, 8, 2, 12.5)]
+SEEDS = (7001, 7002)
+
+
+def build_loop(cg: int, rk: int, sr: int, n_srv: int, ia: float):
+    return build_price_eval_loop(ia, extra_overrides=(
+        f"env_config.topology_config.kwargs.num_communication_groups={cg}",
+        f"env_config.topology_config.kwargs.num_racks_per_communication_group={rk}",
+        f"env_config.topology_config.kwargs.num_servers_per_rack={sr}",
+        f"env_config.node_config.type_1.num_nodes={n_srv}",
+    ))
+
+
+def main():
+    if len(sys.argv) != 3:
+        raise SystemExit(__doc__)
+    ckpt, out_csv = sys.argv[1], sys.argv[2]
+    baselines = {}
+    with open(os.path.join(_ROOT, "docs", "results_round4",
+                           "scaling.csv")) as f:
+        for row in csv.DictReader(f):
+            baselines[int(float(row["servers"]))] = row
+
+    rows = []
+    for n_srv, cg, rk, sr, ia in SIZES:
+        loop = build_loop(cg, rk, sr, n_srv, ia)
+        ev = RLEvalLoop(loop)
+        rets, blocks, lens = [], [], []
+        for j, s in enumerate(SEEDS):
+            r = ev.run(checkpoint_path=ckpt if j == 0 else None, seed=s)
+            rec, stats = r["episode"], r["episode_stats"]
+            rets.append(rec["episode_return"])
+            lens.append(rec["episode_length"])
+            blocks.append(stats.get("blocking_rate", float("nan")))
+            print(f"{n_srv} servers seed {s}: return "
+                  f"{rec['episode_return']:.1f} len "
+                  f"{rec['episode_length']} blocking "
+                  f"{stats.get('blocking_rate'):.3f}", flush=True)
+        loop.close()
+        base = baselines.get(n_srv, {})
+        rows.append({
+            "servers": n_srv,
+            "price_ppo_return": round(float(np.mean(rets)), 1),
+            "price_ppo_blockrate": round(float(np.mean(blocks)), 3),
+            "price_ppo_per_decision": round(
+                float(np.mean([r / max(l, 1)
+                               for r, l in zip(rets, lens)])), 3),
+            "acceptablejct_return": base.get("acceptablejct_return"),
+            "obs_only_ppo_return": base.get("ppo_return"),
+            "sipml_return": base.get("sipml_max_return"),
+        })
+    with open(out_csv, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=list(rows[0]))
+        w.writeheader()
+        w.writerows(rows)
+    for r in rows:
+        print(r, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""The in-kernel OracleJCT heuristic on whatever backend is alive:
+whole episodes — candidate pricing of every degree included — as one
+device dispatch. Prints decisions/s and the mean episode return over a
+few sampled banks (bench-scale env: 32-server RAMP, degree 8, ia-50)."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _ROOT)
+from bench import _make_dataset, make_env_kwargs  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.sim.jax_env import (build_episode_tables,
+                                      build_obs_tables,
+                                      make_oracle_episode_fn,
+                                      sample_job_bank)
+
+    kwargs = make_env_kwargs(_make_dataset())
+    kwargs["jobs_config"]["job_interarrival_time_dist"]["val"] = 50.0
+    kwargs["jobs_config"]["num_training_steps"] = 20
+    kwargs["max_simulation_run_time"] = 2e4
+    kwargs["max_partitions_per_op"] = 8
+    env = RampJobPartitioningEnvironment(**kwargs)
+    env.reset(seed=0)
+    et = build_episode_tables(env)
+    ot = build_obs_tables(env, et)
+    fn = jax.jit(make_oracle_episode_fn(et, ot))
+
+    def bank(seed):
+        return {k: jnp.asarray(v)
+                for k, v in sample_job_bank(et, env, 420, seed).items()}
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(bank(0)))
+    compile_s = time.perf_counter() - t0
+
+    rets, decs, times = [], 0, []
+    for s in (1, 2, 3):
+        b = bank(s)
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(b))
+        times.append(time.perf_counter() - t0)
+        rets.append(float(out["ret"]))
+        decs += int(np.asarray(out["trace"][6]).sum())
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "compile_s": round(compile_s, 1),
+        "episodes": 3,
+        "mean_return": round(float(np.mean(rets)), 1),
+        "decisions_per_sec": round(decs / sum(times), 1),
+        "per_episode_s": [round(t, 2) for t in times],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
