@@ -1,0 +1,72 @@
+"""Price-feature policy episodes on whatever backend is alive: the GNN
+policy consuming IN-KERNEL candidate prices, whole episodes as one
+dispatch (bench-scale env, degree 8, ia-50). The perf row between the
+plain policy episode (no pricing) and the full OracleJCT kernel."""
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, _ROOT)
+from bench import _make_dataset, make_env_kwargs  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.models.policy import GNNPolicy
+    from ddls_tpu.sim.jax_env import (build_episode_tables,
+                                      build_obs_tables,
+                                      make_policy_episode_fn,
+                                      sample_job_bank)
+
+    kwargs = make_env_kwargs(_make_dataset())
+    kwargs["jobs_config"]["job_interarrival_time_dist"]["val"] = 50.0
+    kwargs["jobs_config"]["num_training_steps"] = 20
+    kwargs["max_simulation_run_time"] = 2e4
+    kwargs["max_partitions_per_op"] = 8
+    kwargs["candidate_pricing"] = "auto"
+    kwargs["obs_include_candidate_prices"] = True
+    env = RampJobPartitioningEnvironment(**kwargs)
+    obs = env.reset(seed=0)
+    et = build_episode_tables(env)
+    ot = build_obs_tables(env, et)
+    assert ot.get("with_prices"), "price features not in obs tables"
+    model = GNNPolicy(n_actions=len(env.action_set))
+    params = model.init(jax.random.PRNGKey(1),
+                        jax.tree_util.tree_map(jnp.asarray, obs))
+    fn = jax.jit(make_policy_episode_fn(et, ot, model))
+
+    def bank(seed):
+        return {k: jnp.asarray(v)
+                for k, v in sample_job_bank(et, env, 420, seed).items()}
+
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(bank(0), params,
+                                   jax.random.PRNGKey(0)))
+    compile_s = time.perf_counter() - t0
+    decs, times = 0, []
+    for s in (1, 2, 3):
+        b = bank(s)
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(b, params, jax.random.PRNGKey(s)))
+        times.append(time.perf_counter() - t0)
+        # policy-episode trace layout: (..., jct, t, has_job) — index 8
+        # is the decision flag (the oracle trace's flag is index 6)
+        decs += int(np.asarray(out["trace"][8]).sum())
+    print(json.dumps({
+        "platform": jax.devices()[0].platform,
+        "compile_s": round(compile_s, 1),
+        "episodes": 3,
+        "decisions_per_sec": round(decs / sum(times), 1),
+        "per_episode_s": [round(t, 2) for t in times],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
