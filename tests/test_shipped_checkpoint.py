@@ -57,24 +57,33 @@ def test_shipped_price_checkpoint_restores_and_scores():
                                 rec["episode_length"])
 
 
-def test_shipped_ft128_checkpoint_restores():
-    """The 128-server fine-tune restores onto its documented env
-    surface (full-episode scoring lives in the results artifact — a
-    1600-decision priced episode is too heavy for the suite; this pins
-    the restore path and parameter compatibility)."""
+import pytest
+
+
+@pytest.mark.parametrize("name,cg,rk,sr,n", [
+    ("ppo_price_ft8", 2, 2, 2, 8),
+    ("ppo_price_ft72", 6, 6, 2, 72),
+    ("ppo_price_ft128", 8, 8, 2, 128),
+])
+def test_shipped_per_size_checkpoints_restore(name, cg, rk, sr, n):
+    """Each per-size fine-tune restores onto its documented env surface
+    (full-episode scoring lives in the results artifact — a priced
+    multi-hundred-decision episode per size is too heavy for the
+    suite; this pins the restore path and parameter compatibility)."""
     import jax
 
     loop = _make_eval_loop([
-        "env_config.topology_config.kwargs.num_communication_groups=8",
-        "env_config.topology_config.kwargs"
-        ".num_racks_per_communication_group=8",
-        "env_config.topology_config.kwargs.num_servers_per_rack=2",
-        "env_config.node_config.type_1.num_nodes=128",
+        f"env_config.topology_config.kwargs"
+        f".num_communication_groups={cg}",
+        f"env_config.topology_config.kwargs"
+        f".num_racks_per_communication_group={rk}",
+        f"env_config.topology_config.kwargs.num_servers_per_rack={sr}",
+        f"env_config.node_config.type_1.num_nodes={n}",
     ])
     try:
         before = jax.device_get(loop.state.params)
         loop.load_agent_checkpoint(os.path.join(REPO, "checkpoints",
-                                                "ppo_price_ft128"))
+                                                name))
         after = jax.device_get(loop.state.params)
     finally:
         loop.close()
