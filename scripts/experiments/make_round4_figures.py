@@ -42,8 +42,8 @@ def size_transfer_figure():
     sizes = ["8", "32", "72", "128"]
     series = [
         ("Price-feature policy (fine-tuned per size)", BLUE,
-         [9.0, 122.0, 315.0, 625.0]),
-        ("OracleJCT (ours)", ORANGE, [np.nan, 117.4, 318.0, 622.0]),
+         [9.0, 122.0, 315.0, 617.5]),
+        ("OracleJCT (ours)", ORANGE, [np.nan, 117.4, 318.0, 625.8]),
         ("AcceptableJCT", AQUA, [6.0, 110.0, 306.0, 612.0]),
         ("Obs-only PPO, zero-shot", YELLOW, [6.0, 111.0, -74.0, 97.0]),
     ]
@@ -64,7 +64,7 @@ def size_transfer_figure():
     ax.set_xticks(x, [f"{s} servers" for s in sizes])
     ax.set_ylabel("held-out episode return", color=INK2, fontsize=9)
     ax.set_title("Scaling protocol: the learned policy is best or tied "
-                 "at every size", color=INK, fontsize=11, loc="left")
+                 "at every size (128-server cells: n=8)", color=INK, fontsize=11, loc="left")
     ax.legend(frameon=False, fontsize=8, labelcolor=INK2,
               loc="upper left")
     fig.tight_layout()
