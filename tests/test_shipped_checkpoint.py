@@ -91,3 +91,29 @@ def test_shipped_per_size_checkpoints_restore(name, cg, rk, sr, n):
         lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
         before, after)
     assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+
+def test_shipped_jct_checkpoint_restores():
+    """The second-objective (JCT-blocking) checkpoint restores onto the
+    fixed-load price-feature surface it was trained on."""
+    import jax
+
+    loop = _make_eval_loop([
+        ("env_config.jobs_config.job_interarrival_time_dist._target_="
+         "ddls_tpu.demands.distributions.Fixed"),
+        "env_config.jobs_config.job_interarrival_time_dist.val=50.0",
+        "env_config.reward_function=multi_objective_jct_blocking",
+        "env_config.reward_function_kwargs.fail_reward=null",
+        "env_config.reward_function_kwargs.success_reward=null",
+    ])
+    try:
+        before = jax.device_get(loop.state.params)
+        loop.load_agent_checkpoint(os.path.join(REPO, "checkpoints",
+                                                "ppo_jct_blocking"))
+        after = jax.device_get(loop.state.params)
+    finally:
+        loop.close()
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        before, after)
+    assert max(jax.tree_util.tree_leaves(moved)) > 0
