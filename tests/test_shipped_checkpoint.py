@@ -117,3 +117,35 @@ def test_shipped_jct_checkpoint_restores():
         lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
         before, after)
     assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+
+def test_shipped_device_trained_checkpoint_restores_and_scores():
+    """The attribution-control checkpoint (plain obs, device-collected)
+    restores onto the plain env_load32 surface and clears the same
+    sanity floor as the price policy."""
+    from ddls_tpu.config import load_config
+    from ddls_tpu.train import RLEvalLoop, make_epoch_loop
+    from train_from_config import build_epoch_loop_kwargs
+
+    cfg = load_config(os.path.join(REPO, "scripts",
+                                   "ramp_job_partitioning_configs"),
+                      "rllib_config",
+                      ["env_config=env_load32",
+                       "env_config.jobs_config.job_interarrival_time_"
+                       "dist.val=80.0"])
+    kwargs = build_epoch_loop_kwargs(cfg)
+    kwargs["num_envs"] = 1
+    kwargs["rollout_length"] = 1
+    kwargs["evaluation_interval"] = None
+    loop = make_epoch_loop("ppo", **kwargs)
+    try:
+        ev = RLEvalLoop(loop)
+        r = ev.run(checkpoint_path=os.path.join(
+            REPO, "checkpoints", "ppo_device_trained"), seed=7005)
+        rec = r["episode"]
+    finally:
+        loop.close()
+    per_decision = rec["episode_return"] / max(rec["episode_length"], 1)
+    assert np.isfinite(per_decision)
+    assert per_decision > 0.2, (rec["episode_return"],
+                                rec["episode_length"])
