@@ -139,8 +139,44 @@ def probe_backend(timeout: float, force_cpu: bool = False) -> str | None:
     return tail[-1] if tail else f"jax backend probe exited rc={out.returncode}"
 
 
-def make_env_kwargs(dataset_dir: str) -> dict:
+def _dataset_pad_bounds(dataset_dir: str) -> dict:
+    """Tight obs padding for the bench dataset: max op/dep counts over its
+    graph files. Pad-to-dataset-bound is the reference's own observation
+    policy (its 150-node pad IS the small_graphs dataset's bound,
+    ddls/environments/ramp_job_partitioning/observations/...observation.py);
+    padding a small dataset to 150/512 instead just drags dead masked rows
+    through every GNN forward AND backward of the update (~10x dead rows at
+    this dataset's 30-op bound), without changing a single output bit —
+    padded rows are fully masked (docs/perf_round5.md)."""
+    if dataset_dir in _PAD_BOUNDS_CACHE:
+        return _PAD_BOUNDS_CACHE[dataset_dir]
+    import glob
+
+    from ddls_tpu.graphs.readers import read_graph_file
+
+    paths = sorted(glob.glob(os.path.join(dataset_dir, "*.txt")))
+    if not paths:
+        # max_nodes=0 would read as "padding disabled" downstream and break
+        # obs stacking with a far-away shape error; fail at the source
+        raise FileNotFoundError(f"no *.txt graph files in {dataset_dir}")
+    max_ops = max_deps = 0
+    for path in paths:
+        g = read_graph_file(path)
+        max_ops = max(max_ops, g.n_ops)
+        max_deps = max(max_deps, g.n_deps)
+    bounds = {"max_nodes": max_ops, "max_edges": max_deps}
+    _PAD_BOUNDS_CACHE[dataset_dir] = bounds
+    return bounds
+
+
+_PAD_BOUNDS_CACHE: dict = {}
+
+
+def make_env_kwargs(dataset_dir: str,
+                    pad_bounds: dict | None = None) -> dict:
     """Reference-scale env config (BASELINE.md env_dev.yaml analogue)."""
+    if pad_bounds is None:
+        pad_bounds = _dataset_pad_bounds(dataset_dir)
     return dict(
         topology_config={"type": "ramp", "kwargs": {
             "num_communication_groups": 4,
@@ -168,10 +204,9 @@ def make_env_kwargs(dataset_dir: str) -> dict:
         reward_function="job_acceptance",
         reward_function_kwargs={"fail_reward": -1, "success_reward": 1},
         max_simulation_run_time=1e6,
-        # max_edges mirrors env_dev.yaml: without it the obs pads edges to
-        # the fully-connected bound (11,175 for 150 nodes), dragging ~20x
-        # dead padding through every GNN forward and update
-        pad_obs_kwargs={"max_nodes": 150, "max_edges": 512})
+        # pad to the dataset bound (see _dataset_pad_bounds): same policy
+        # as the reference's 150-node pad for ITS dataset, zero dead rows
+        pad_obs_kwargs=dict(pad_bounds))
 
 
 def make_env_fn(dataset_dir: str):
@@ -334,11 +369,16 @@ def run_bench(args, platform_note: str | None,
         # CPU (explicit, fallback, or accelerator-less host) is a smoke
         # measurement, not the headline: the scanned SGD update alone takes
         # minutes at full size on one host core, so shrink to something
-        # that completes
+        # that completes. Warmup matters: env stepping is ~5x slower for the
+        # first ~300 steps of an env's life (memo caches filling, cluster
+        # state maturing — docs/perf_round5.md), so the timed epochs must
+        # start from steady state or they measure the transient
         args.num_envs = min(args.num_envs, 4)
-        args.rollout_length = min(args.rollout_length, 16)
-        args.timed_epochs = min(args.timed_epochs, 2)
+        args.rollout_length = min(args.rollout_length, 32)
+        args.timed_epochs = min(args.timed_epochs, 3)
         args.num_sgd_iter = min(args.num_sgd_iter, 10)
+        # 10 epochs x 32 steps = 320 steps/env, past the ~300-step transient
+        args.warmup_epochs = max(args.warmup_epochs, 10)
 
     from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
     from ddls_tpu.parallel.mesh import make_mesh
@@ -388,6 +428,11 @@ def run_bench(args, platform_note: str | None,
     for i in range(args.warmup_epochs):
         rng, sub = jax.random.split(rng)
         state, _, update_args = one_epoch(state, sub)
+        # warmup must leave room for >=1 timed epoch + the JSON emit (the
+        # probe may already have burned its timeout against a wedged TPU);
+        # a short warmup only biases the smoke number slow, never kills it
+        if time.perf_counter() - process_start > 0.6 * args.budget_seconds:
+            break
 
     # FLOPs of ONE compiled update step (cached compile: same shapes as the
     # warmed-up call). Grabbed before timing so it can't perturb the clock.

@@ -158,6 +158,31 @@ class TestGNNPolicy:
         np.testing.assert_allclose(logits[2], solo_logits, atol=5e-3)
         np.testing.assert_allclose(values[2], solo_value, atol=5e-3)
 
+    def test_flat_batched_matches_vmapped(self, model_params):
+        """batched_policy_apply runs the flattened mega-graph forward; it
+        computes the same sums as vmapping the single-sample __call__
+        (every parameterised op is row-wise; segment sums keep per-node
+        edge order), so outputs agree to f32 reassociation tolerance — XLA
+        may tile the row-wise matmuls differently per shape, so exact
+        bitwise equality only holds at some shapes. Masked (-inf) entries
+        must agree exactly."""
+        from ddls_tpu.models.policy import vmapped_policy_apply
+
+        model, params = model_params
+        rng = np.random.default_rng(7)
+        batch = [_rand_obs(rng, n=int(rng.integers(2, 8))) for _ in range(6)]
+        stacked = {k: jnp.stack([jnp.asarray(o[k]) for o in batch])
+                   for k in batch[0]}
+        lo_f, va_f = jax.jit(
+            lambda p, o: batched_policy_apply(model, p, o))(params, stacked)
+        lo_v, va_v = jax.jit(
+            lambda p, o: vmapped_policy_apply(model, p, o))(params, stacked)
+        assert bool(jnp.all(jnp.isfinite(lo_f) == jnp.isfinite(lo_v)))
+        np.testing.assert_allclose(
+            np.where(np.isfinite(lo_f), lo_f, 0.0),
+            np.where(np.isfinite(lo_v), lo_v, 0.0), atol=1e-5)
+        np.testing.assert_allclose(va_f, va_v, atol=1e-5)
+
     def test_grads_flow(self, model_params):
         model, params = model_params
         obs = jax.tree.map(jnp.asarray, _rand_obs(np.random.default_rng(6)))

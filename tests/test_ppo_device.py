@@ -89,11 +89,13 @@ def test_rebuilt_obs_reproduces_kernel_forward(setup):
     logp_re = jax.nn.log_softmax(logits)[
         jnp.arange(T * B), traj["actions"].reshape(-1)]
     # the rebuilt obs reproduce the kernel forward up to XLA's
-    # cross-compilation f32 fusion variance (a few ulps)
+    # cross-compilation f32 fusion variance; batched_policy_apply's flat
+    # mega-graph path reassociates sums shape-dependently vs the kernel's
+    # single-sample forward, bounded ~1e-5 (tests/test_models.py)
     np.testing.assert_allclose(np.asarray(logp_re).reshape(T, B),
-                               traj["logp"], rtol=0, atol=3e-6)
+                               traj["logp"], rtol=0, atol=1e-5)
     np.testing.assert_allclose(np.asarray(values).reshape(T, B),
-                               traj["values"], rtol=1e-5, atol=3e-6)
+                               traj["values"], rtol=1e-5, atol=1e-5)
     # episode boundaries appear as segments chain across collects
     # (~33 arrivals per episode at this horizon; 12 decisions/collect)
     n_dones = int(traj["dones"].sum())
