@@ -74,7 +74,11 @@ def compiled_cost_analysis(jitted, *args, n_dev: int,
 
     - the compiled analysis reports the PER-DEVICE partitioned program's
       FLOPs, not the global computation's, so the result is scaled by
-      ``n_dev`` to match what ``update_cost_analysis`` returns;
+      ``n_dev`` to match what ``update_cost_analysis`` returns. The
+      uniform n_dev scaling assumes the pure data-parallel mesh this
+      bench builds (make_mesh dp-only); a model-parallel update would
+      need a different global-FLOPs reconstruction — revisit if the
+      bench mesh ever shards params;
     - the in-process compile dispatches through the tunnel, which can
       wedge for hours (CLAUDE.md), and a wedged compile cannot be
       interrupted from Python — so a watchdog thread emits

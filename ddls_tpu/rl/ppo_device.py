@@ -79,30 +79,30 @@ class DevicePPOCollector:
     def _harvest_episodes(self, trace) -> list:
         """Episode records at done boundaries, from the traced in-kernel
         counters — the device counterpart of
-        `rollout.py:harvest_episode_record`. ``acceptance_rate`` /
-        ``blocking_rate`` use decided arrivals (accepted+blocked) as the
-        denominator; the host cluster divides by ALL arrivals, which also
-        counts jobs still queued when the episode ends — a small, documented
-        divergence (the kernel trace carries no arrival counter)."""
+        `rollout.py:harvest_episode_record`, using the HOST denominators:
+        ``acceptance_rate`` = completed/arrived and ``blocking_rate`` =
+        blocked/arrived where arrived counts every job that entered the
+        queue, decided or not (cluster.py:1020-1023; the kernel traces
+        the arrival pointer as ``ep_arrived``), so device- and
+        host-collected runs log comparable rates."""
         episodes = []
         done = trace["done"]  # [T, B] after the caller's swap
         T, B = done.shape
         for t in range(T):
             self._ep_len += 1
             for b in np.nonzero(done[t])[0]:
-                acc = int(trace["ep_accepted"][t, b])
                 blk = int(trace["ep_blocked"][t, b])
                 com = int(trace["ep_completed"][t, b])
-                decided = acc + blk
+                arr = int(trace["ep_arrived"][t, b])
                 episodes.append({
                     "env_index": int(b),
                     "episode_return": float(trace["ep_return"][t, b]),
                     "episode_length": int(self._ep_len[b]),
+                    "num_jobs_arrived": arr,
                     "num_jobs_completed": com,
                     "num_jobs_blocked": blk,
-                    # host formulas: completed/arrived, blocked/arrived
-                    "acceptance_rate": com / decided if decided else 0.0,
-                    "blocking_rate": blk / decided if decided else 0.0,
+                    "acceptance_rate": com / arr if arr else 0.0,
+                    "blocking_rate": blk / arr if arr else 0.0,
                 })
                 self._ep_len[b] = 0
         return episodes

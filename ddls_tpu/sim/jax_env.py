@@ -1184,7 +1184,13 @@ def make_episode_fn(et: EpisodeTables):
          counters) = final
         return {"trace": trace, "accepted": counters[0],
                 "blocked": counters[1], "ret": counters[2],
-                "completed": completed, "t": carry[0], "done": done}
+                "completed": completed, "t": carry[0], "done": done,
+                # host episode finalisation blocks anything still running
+                # at simulation end (cluster.py:1010-1013); num_jobs_blocked
+                # parity = decision blocks + still-running slots
+                "blocked_total": (counters[1]
+                                  + carry[4].sum().astype(jnp.int32)),
+                "arrived": ptr}
 
     # bank arrays are traced arguments: one compile serves every bank of
     # the same shape (per-seed episodes, vmapped batches)
@@ -1424,7 +1430,16 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
         return {"trace": trace, "accepted": counters[0],
                 "blocked": counters[1], "ret": counters[2],
                 "completed": final[5], "t": final[0][0],
-                "done": final[4]}
+                "done": final[4],
+                # host episode finalisation blocks anything still running
+                # at simulation end (cluster.py:1010-1013); num_jobs_blocked
+                # parity = decision blocks + still-running slots
+                "blocked_total": (counters[1]
+                                  + final[0][4].sum().astype(jnp.int32)),
+                # ptr = jobs that entered the queue (host num_jobs_arrived
+                # semantics, cluster.py:240) — the same expression the
+                # segment kernel traces as ep_arrived
+                "arrived": final[2]}
 
     return jax.jit(episode)
 
@@ -1496,6 +1511,10 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
             new_carry, (reward, accept, cause, jct) = k.decision(
                 bank, carry, action, row)
             accepted, blocked, ret = counters
+            # unlike the policy-episode kernel these counters need no
+            # has_job guard: every segment step has a queued job by
+            # construction (advance exits only on queue_row >= 0 or done,
+            # and done states reset to fresh — which queues bank job 0)
             counters2 = (accepted + accept.astype(jnp.int32),
                          blocked + (~accept).astype(jnp.int32),
                          ret + reward)
@@ -1513,8 +1532,20 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
             # them from the carried state the very same step)
             out = {"action": action, "logp": logp, "value": value,
                    "reward": reward.astype(dt), "done": ended,
-                   "ep_accepted": counters2[0], "ep_blocked": counters2[1],
+                   "ep_accepted": counters2[0],
+                   # at the episode-end step, fold in the jobs still
+                   # running at simulation end — the host finalisation
+                   # blocks them (cluster.py:1010-1013), so harvested
+                   # num_jobs_blocked/blocking_rate match host records
+                   "ep_blocked": counters2[1] + jnp.where(
+                       ended, carry3[4].sum().astype(jnp.int32), 0),
                    "ep_return": counters2[2], "ep_completed": completed3,
+                   # ptr counts every bank job that has entered the queue,
+                   # decided or not — the host's num_jobs_arrived semantics
+                   # (cluster.py:240); parity pinned via the policy-episode
+                   # kernel's identical expression
+                   # (tests/test_jax_policy_episode.py)
+                   "ep_arrived": ptr3,
                    **fields}
             return state4, out
 
@@ -1652,6 +1683,11 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
         return {"trace": trace, "accepted": counters[0],
                 "blocked": counters[1], "ret": counters[2],
                 "completed": final[5], "t": final[0][0],
-                "done": final[4]}
+                "done": final[4],
+                # host-parity blocked count incl. jobs still running at
+                # simulation end (cluster.py:1010-1013)
+                "blocked_total": (counters[1]
+                                  + final[0][4].sum().astype(jnp.int32)),
+                "arrived": final[2]}
 
     return jax.jit(episode)

@@ -357,11 +357,21 @@ class RLEpochLoop:
             rng_state = np.random.get_state()
             try:
                 np.random.seed(self.seed + 31)
-                ias = [env0.cluster.jobs_generator.interarrival_dist
-                       .sample() for _ in range(100)]
+                ias = np.array([env0.cluster.jobs_generator
+                                .interarrival_dist.sample()
+                                for _ in range(1000)], np.float64)
             finally:
                 np.random.set_state(rng_state)
-            n_jobs = int(msrt / max(float(np.mean(ias)), 1e-9) * 1.1) + 10
+            mean = max(float(ias.mean()), 1e-9)
+            base = msrt / mean
+            # provision for the sum of interarrivals, not its mean: a
+            # heavy-tailed distribution can draw a lighter-than-mean bank
+            # and exhaust early (silently truncating in-kernel episodes),
+            # so add a 2-sigma CLT margin on the horizon's arrival count
+            # plus 10% slack
+            n_jobs = int(base * 1.1
+                         + 2.0 * (float(ias.std()) / mean) * np.sqrt(base)
+                         ) + 10
         banks = [sample_job_bank(et, env0, n_jobs,
                                  self._collect_seed + 7559 * i + 17)
                  for i in range(self.num_envs)]

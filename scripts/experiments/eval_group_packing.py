@@ -1,0 +1,113 @@
+"""Run the extracted FixedDegreePacking rule (and comparison heuristics)
+through the round-4 held-out protocols (VERDICT r4 item 1 'done'
+criterion): the 20-seed fixed-load table, the load sweep, and the
+8/72/128-server scaling protocol.
+
+Usage: python eval_group_packing.py <mode> [actor]
+  mode:  seeds20 | loadsweep | sizes
+  actor: fixed_degree_packing (default; ":D" suffix pins degree D,
+         e.g. fixed_degree_packing:4) | any BASELINE_ACTORS name
+"""
+import sys
+
+import numpy as np
+
+from _eval_common import _ROOT, CONFIG_PATH  # noqa: F401
+
+from ddls_tpu.envs.baselines import BASELINE_ACTORS  # noqa: E402
+
+
+def make_env(ia: float, topo=None, pricing: bool = False):
+    from ddls_tpu.config import load_config
+
+    overrides = [
+        "env_config=env_load32",
+        ("env_config.jobs_config.job_interarrival_time_dist._target_="
+         "ddls_tpu.demands.distributions.Fixed"),
+        f"env_config.jobs_config.job_interarrival_time_dist.val={ia}",
+    ]
+    if pricing:
+        overrides.append("env_config.candidate_pricing=auto")
+    if topo:
+        c, r, s = topo
+        overrides += [
+            f"env_config.topology_config.kwargs.num_communication_groups={c}",
+            ("env_config.topology_config.kwargs."
+             f"num_racks_per_communication_group={r}"),
+            f"env_config.topology_config.kwargs.num_servers_per_rack={s}",
+            f"env_config.node_config.type_1.num_nodes={c * r * s}",
+        ]
+    cfg = load_config(CONFIG_PATH, "rllib_config", overrides)
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+
+    env_cfg = {k: v for k, v in cfg["env_config"].items()
+               if k != "_target_"}
+    return RampJobPartitioningEnvironment(**env_cfg)
+
+
+def run_episode(env, actor, seed: int):
+    obs = env.reset(seed=seed)
+    done, ret, steps = False, 0.0, 0
+    while not done:
+        job = next(iter(env.cluster.job_queue.jobs.values()))
+        a = actor.compute_action(obs, job_to_place=job, env=env)
+        obs, reward, done, _ = env.step(a)
+        ret += reward
+        steps += 1
+    return ret, steps
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "seeds20"
+    name = sys.argv[2] if len(sys.argv) > 2 else "fixed_degree_packing"
+    if ":" in name:  # e.g. fixed_degree_packing:4
+        base, deg = name.split(":")
+        actor = BASELINE_ACTORS[base](degree=int(deg))
+    else:
+        actor = BASELINE_ACTORS[name]()
+    pricing = name == "oracle_jct"
+
+    if mode == "seeds20":
+        env = make_env(50.0, pricing=pricing)
+        seeds = [1799] + list(range(7001, 7020))
+        vals = []
+        for s in seeds:
+            ret, steps = run_episode(env, actor, s)
+            vals.append(ret)
+            print(f"seed {s}: return {ret:.1f} len {steps}", flush=True)
+        arr = np.array(vals)
+        print(f"{name}: mean {arr.mean():.2f} sd {arr.std(ddof=1):.2f} "
+              f"sem {arr.std(ddof=1) / np.sqrt(len(arr)):.2f}")
+    elif mode == "loadsweep":
+        means = []
+        for ia in (30.0, 50.0, 80.0, 120.0, 200.0):
+            env = make_env(ia, pricing=pricing)
+            pds = []
+            for s in range(7005, 7013):
+                ret, steps = run_episode(env, actor, s)
+                pds.append(ret / max(steps, 1))
+            means.append(np.mean(pds))
+            print(f"ia {ia:.0f}: per-decision mean {np.mean(pds):.3f} "
+                  f"(n={len(pds)})", flush=True)
+        print(f"{name} sweep mean across loads: {np.mean(means):.3f}")
+    elif mode == "sizes":
+        # the round-4 scaling protocol: constant per-server load
+        # (docs/results_round4/scaling.md): ia = 50 * 32 / n_servers
+        for topo, ia in (((2, 2, 2), 200.0), ((6, 6, 2), 22.2),
+                         ((8, 8, 2), 12.5)):
+            n_srv = topo[0] * topo[1] * topo[2]
+            env = make_env(ia, topo=topo, pricing=pricing)
+            vals = []
+            for s in range(7001, 7009):
+                ret, steps = run_episode(env, actor, s)
+                vals.append(ret)
+            arr = np.array(vals)
+            print(f"{n_srv} servers (group={topo[1] * topo[2]}): "
+                  f"mean {arr.mean():.1f} sd {arr.std(ddof=1):.1f} "
+                  f"(n={len(arr)})", flush=True)
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,273 @@
+"""Extract the decision rule the round-4 policies converged to (VERDICT r4
+item 1).
+
+Three independently trained policies (price-feature mixed-load PPO,
+obs-only host PPO fine-tune, obs-only device-collected PPO) produce
+bit-identical greedy decisions on every held-out protocol and
+significantly beat OracleJCT (docs/results_round4/RESULTS.md §4). This
+script characterises that rule.
+
+Modes:
+  dump <ckpt> <out.npz> [--loads 30,50,80,120,200] [--seeds 7001-7010]
+      Greedy policy on held-out envs with candidate pricing enabled
+      (pricing feeds the comparison columns only; obs stays plain).
+      Per decision: 17 graph features, action mask, policy action,
+      AcceptableJCT/OracleJCT actions, per-candidate priced-JCT/SLA
+      ratios, job scalars, cluster occupancy, reward.
+  analyze <in.npz>
+      Agreement tables, disagreement conditioning, threshold fits.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+from _eval_common import _ROOT, CONFIG_PATH  # noqa: F401
+
+from ddls_tpu.envs.baselines import AcceptableJCT, OracleJCT  # noqa: E402
+
+
+def build_loop(ia: float, price_obs: bool = False, topo=None):
+    """Eval loop on env_load32 at Fixed interarrival ``ia``; candidate
+    pricing always ON (for the oracle comparison columns), price obs
+    features only when the checkpoint was trained on them. ``topo``
+    optionally rescales the cluster (c, r, s)."""
+    from ddls_tpu.config import load_config
+    from ddls_tpu.train import make_epoch_loop
+    from train_from_config import build_epoch_loop_kwargs
+
+    overrides = [
+        "env_config=env_load32",
+        "env_config.candidate_pricing=auto",
+        f"env_config.obs_include_candidate_prices={str(price_obs).lower()}",
+        ("env_config.jobs_config.job_interarrival_time_dist._target_="
+         "ddls_tpu.demands.distributions.Fixed"),
+        f"env_config.jobs_config.job_interarrival_time_dist.val={ia}",
+    ]
+    if topo:
+        c, r, s = topo
+        overrides += [
+            f"env_config.topology_config.kwargs.num_communication_groups={c}",
+            ("env_config.topology_config.kwargs."
+             f"num_racks_per_communication_group={r}"),
+            f"env_config.topology_config.kwargs.num_servers_per_rack={s}",
+            f"env_config.node_config.type_1.num_nodes={c * r * s}",
+        ]
+    cfg = load_config(CONFIG_PATH, "rllib_config", overrides)
+    kwargs = build_epoch_loop_kwargs(cfg)
+    kwargs["num_envs"] = 1
+    kwargs["rollout_length"] = 1
+    kwargs["evaluation_interval"] = None
+    return make_epoch_loop("ppo", **kwargs)
+
+
+def dump(ckpt: str, out_path: str, loads, seeds, price_obs: bool,
+         topo=None) -> None:
+    from ddls_tpu.rl.rollout import stack_obs
+
+    acc = AcceptableJCT()
+    orc = OracleJCT()
+    rows = []
+    n_act = None
+    loaded = False
+    for ia in loads:
+        loop = build_loop(ia, price_obs=price_obs, topo=topo)
+        if not loaded:
+            loop.load_agent_checkpoint(ckpt)
+            params_cache = loop  # checkpoint persists across loops via state
+            loaded = True
+        else:
+            loop.load_agent_checkpoint(ckpt)
+        for seed in seeds:
+            env = loop.make_eval_env()
+            obs = env.reset(seed=seed)
+            done, t, ret = False, 0, 0.0
+            while not done:
+                job = next(iter(env.cluster.job_queue.jobs.values()))
+                gf = np.asarray(obs["graph_features"], np.float32)
+                mask = np.asarray(obs["action_mask"], np.int32)
+                n_act = len(mask)
+                a_pol = int(loop._greedy_actions(stack_obs([obs]))[0])
+                a_acc = acc.compute_action(obs, job_to_place=job)
+                a_orc = orc.compute_action(obs, job_to_place=job, env=env)
+                prices = getattr(env, "candidate_prices", {}) or {}
+                limit = max(job.max_acceptable_jct, 1e-30)
+                ratio = np.full(n_act, np.nan, np.float32)
+                for a, priced in prices.items():
+                    if priced is not None:
+                        ratio[a] = priced[0] / limit
+                free = (env.cluster.topology.num_workers
+                        - len(env.cluster.mounted_workers))
+                rows.append({
+                    "ia": ia, "seed": seed, "t": t,
+                    "graph_features": gf[:17],
+                    "mask": mask,
+                    "a_pol": a_pol, "a_acc": a_acc, "a_orc": a_orc,
+                    "price_ratio": ratio,
+                    "seq_jct": job.seq_completion_time,
+                    "max_jct": job.max_acceptable_jct,
+                    "sla_frac": job.max_acceptable_jct_frac,
+                    "n_ops": job.graph.n_ops,
+                    "n_deps": job.graph.n_deps,
+                    "steps": job.num_training_steps,
+                    "free_workers": free,
+                    "n_running": len(env.cluster.jobs_running),
+                })
+                obs, reward, done, _ = env.step(a_pol)
+                rows[-1]["reward"] = float(reward)
+                ret += reward
+                t += 1
+            print(f"ia {ia} seed {seed}: return {ret:.1f} over {t} "
+                  f"decisions", flush=True)
+        loop.close()
+    keys_scalar = ["ia", "seed", "t", "a_pol", "a_acc", "a_orc", "seq_jct",
+                   "max_jct", "sla_frac", "n_ops", "n_deps", "steps",
+                   "free_workers", "n_running", "reward"]
+    out = {k: np.array([r[k] for r in rows]) for k in keys_scalar}
+    out["graph_features"] = np.stack([r["graph_features"] for r in rows])
+    out["mask"] = np.stack([r["mask"] for r in rows])
+    out["price_ratio"] = np.stack([r["price_ratio"] for r in rows])
+    np.savez_compressed(out_path, **out)
+    print(f"wrote {len(rows)} decisions -> {out_path}")
+
+
+def _rule_actions(d, kind: str) -> np.ndarray:
+    """Vectorised candidate rules evaluated on the dump."""
+    n = len(d["a_pol"])
+    mask = d["mask"].astype(bool)
+    ratio = d["price_ratio"]
+    acts = np.zeros(n, np.int64)
+    for i in range(n):
+        valid = np.nonzero(mask[i])[0]
+        valid = valid[valid != 0]
+        if kind == "oracle":  # smallest degree meeting SLA, else min-JCT
+            ok = [a for a in valid if np.isfinite(ratio[i, a])
+                  and ratio[i, a] <= 1.0]
+            if ok:
+                acts[i] = min(ok)
+            else:
+                placeable = [a for a in valid if np.isfinite(ratio[i, a])]
+                acts[i] = (min(placeable, key=lambda a: ratio[i, a])
+                           if placeable else (valid[0] if len(valid) else 0))
+        else:
+            raise ValueError(kind)
+    return acts
+
+
+def analyze(in_path: str) -> None:
+    d = np.load(in_path)
+    n = len(d["a_pol"])
+    a_pol, a_acc, a_orc = d["a_pol"], d["a_acc"], d["a_orc"]
+    print(f"{n} decisions, loads {sorted(set(d['ia']))}, "
+          f"{len(set(map(tuple, np.stack([d['ia'], d['seed']], 1))))} "
+          f"episodes")
+    print(f"\naction distribution (policy): "
+          f"{dict(zip(*np.unique(a_pol, return_counts=True)))}")
+    print(f"action distribution (oracle): "
+          f"{dict(zip(*np.unique(a_orc, return_counts=True)))}")
+    print(f"\nagreement pol==oracle: {np.mean(a_pol == a_orc):.3f}")
+    print(f"agreement pol==acceptable: {np.mean(a_pol == a_acc):.3f}")
+    print(f"agreement oracle==acceptable: {np.mean(a_orc == a_acc):.3f}")
+
+    per_load = {}
+    for ia in sorted(set(d["ia"])):
+        m = d["ia"] == ia
+        per_load[ia] = (np.mean(a_pol[m] == a_orc[m]),
+                        np.mean(a_pol[m] > a_orc[m]),
+                        np.mean(a_pol[m] < a_orc[m]))
+    print("\nper-load: ia -> (agree, pol>orc, pol<orc)")
+    for ia, v in per_load.items():
+        print(f"  {ia:6.0f}: agree {v[0]:.3f}  higher {v[1]:.3f}  "
+              f"lower {v[2]:.3f}")
+
+    dis = a_pol != a_orc
+    if dis.any():
+        print(f"\n--- {dis.sum()} disagreements ---")
+        r_pol = np.array([d["price_ratio"][i, a] if np.isfinite(
+            d["price_ratio"][i, a]) else np.nan
+            for i, a in enumerate(a_pol)])
+        r_orc = np.array([d["price_ratio"][i, a] if np.isfinite(
+            d["price_ratio"][i, a]) else np.nan
+            for i, a in enumerate(a_orc)])
+        occ = d["n_running"][dis]
+        free = d["free_workers"][dis]
+        print(f"policy action ratio at disagreements: "
+              f"median {np.nanmedian(r_pol[dis]):.3f}")
+        print(f"oracle action ratio at disagreements: "
+              f"median {np.nanmedian(r_orc[dis]):.3f}")
+        print(f"free workers at disagreements: median {np.median(free):.0f} "
+              f"(overall {np.median(d['free_workers']):.0f})")
+        print(f"jobs running at disagreements: median {np.median(occ):.0f} "
+              f"(overall {np.median(d['n_running']):.0f})")
+        print(f"SLA frac at disagreements: "
+              f"median {np.median(d['sla_frac'][dis]):.3f} "
+              f"(overall {np.median(d['sla_frac']):.3f})")
+        hi = (a_pol > a_orc) & dis
+        lo = (a_pol < a_orc) & dis
+        print(f"policy goes HIGHER than oracle: {hi.sum()} "
+              f"({100 * hi.sum() / max(dis.sum(), 1):.0f}%), "
+              f"LOWER: {lo.sum()}")
+        for name, m in (("HIGHER", hi), ("LOWER", lo)):
+            if m.any():
+                print(f"  {name}: pol acts "
+                      f"{dict(zip(*np.unique(a_pol[m], return_counts=True)))}"
+                      f" vs orc "
+                      f"{dict(zip(*np.unique(a_orc[m], return_counts=True)))}")
+
+    # shallow decision tree on (features) -> action, and -> disagreement
+    try:
+        from sklearn.tree import DecisionTreeClassifier, export_text
+    except ImportError:
+        print("\n(sklearn unavailable: skipping tree fits)")
+        return
+    feats = np.concatenate([
+        d["graph_features"], d["mask"].astype(np.float32),
+        np.nan_to_num(d["price_ratio"], nan=2.0),
+        d["free_workers"][:, None], d["n_running"][:, None],
+    ], axis=1)
+    names = ([f"gf{j}" for j in range(17)]
+             + [f"mask{j}" for j in range(d["mask"].shape[1])]
+             + [f"ratio{j}" for j in range(d["price_ratio"].shape[1])]
+             + ["free_workers", "n_running"])
+    rng = np.random.RandomState(0)
+    idx = rng.permutation(n)
+    cut = int(0.8 * n)
+    tr, te = idx[:cut], idx[cut:]
+    for depth in (2, 3, 4):
+        clf = DecisionTreeClassifier(max_depth=depth, random_state=0)
+        clf.fit(feats[tr], a_pol[tr])
+        acc_te = clf.score(feats[te], a_pol[te])
+        print(f"\ntree depth {depth}: held-out action accuracy {acc_te:.3f}")
+        if depth <= 3:
+            print(export_text(clf, feature_names=names, max_depth=depth))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("mode", choices=("dump", "analyze"))
+    p.add_argument("path", help="checkpoint dir (dump) or npz (analyze)")
+    p.add_argument("out", nargs="?", help="output npz (dump)")
+    p.add_argument("--loads", default="30,50,80,120,200")
+    p.add_argument("--seeds", default="7001-7008")
+    p.add_argument("--price-obs", action="store_true",
+                   help="checkpoint consumes price observation features")
+    p.add_argument("--topo", default=None,
+                   help="c,r,s cluster rescale (e.g. 8,8,2 = 128 servers)")
+    args = p.parse_args()
+    if args.mode == "dump":
+        loads = [float(x) for x in args.loads.split(",")]
+        if "-" in args.seeds:
+            a, b = args.seeds.split("-")
+            seeds = list(range(int(a), int(b) + 1))
+        else:
+            seeds = [int(x) for x in args.seeds.split(",")]
+        topo = (tuple(int(x) for x in args.topo.split(","))
+                if args.topo else None)
+        dump(args.path, args.out, loads, seeds, args.price_obs, topo=topo)
+    else:
+        analyze(args.path)
+
+
+if __name__ == "__main__":
+    main()

@@ -158,7 +158,19 @@ assert int(out["accepted"]) == host["accepted"]
 assert int(out["blocked"]) == host["blocked"]
 assert int(out["completed"]) == host["completed"]
 assert abs(float(out["ret"]) - host["ret"]) < 1e-9
-print(f"EPISODE_PARITY_OK decisions={len(decisions)}")
+
+# ---- episode-record parity vs the host cluster's finalised stats:
+# arrivals (the device collectors' rate denominator) and num_jobs_blocked
+# INCLUDING the host finalisation that blocks jobs still running at
+# simulation end (cluster.py:1010-1013)
+er = env.cluster.episode_stats
+assert int(out["arrived"]) == n_arrived == er["num_jobs_arrived"], (
+    int(out["arrived"]), n_arrived, er["num_jobs_arrived"])
+assert int(out["blocked_total"]) == er["num_jobs_blocked"], (
+    int(out["blocked_total"]), int(out["blocked"]), er["num_jobs_blocked"])
+still = int(out["blocked_total"]) - int(out["blocked"])
+print(f"EPISODE_PARITY_OK decisions={len(decisions)} "
+      f"still_running_at_end={still}")
 """
 
 

@@ -153,11 +153,19 @@ def test_episode_records_from_traced_counters(setup):
                 len_acc[b] = 0
         for e in out["episodes"]:
             assert set(e) >= {"env_index", "episode_return",
-                              "episode_length", "num_jobs_completed",
-                              "num_jobs_blocked", "acceptance_rate",
-                              "blocking_rate"}
+                              "episode_length", "num_jobs_arrived",
+                              "num_jobs_completed", "num_jobs_blocked",
+                              "acceptance_rate", "blocking_rate"}
             assert 0.0 <= e["acceptance_rate"] <= 1.0
             assert 0.0 <= e["blocking_rate"] <= 1.0
+            # host denominator semantics (cluster.py:1020-1023): arrived
+            # counts queued-undecided jobs too, so it bounds decided+done
+            arr = e["num_jobs_arrived"]
+            assert arr >= e["num_jobs_completed"] + e["num_jobs_blocked"]
+            assert e["acceptance_rate"] == (
+                e["num_jobs_completed"] / arr if arr else 0.0)
+            assert e["blocking_rate"] == (
+                e["num_jobs_blocked"] / arr if arr else 0.0)
         records = [(e["episode_return"], e["episode_length"])
                    for e in out["episodes"]]
         # records appear in the same (t, b) order as the host scan above

@@ -149,3 +149,47 @@ def test_shipped_device_trained_checkpoint_restores_and_scores():
     assert np.isfinite(per_decision)
     assert per_decision > 0.2, (rec["episode_return"],
                                 rec["episode_length"])
+
+
+def test_device_trained_policy_is_fixed_degree_packing():
+    """Pins the round-5 rule extraction (VERDICT r4 item 1): the shipped
+    obs-only device-collected policy's greedy decisions are EXACTLY
+    FixedDegreePacking(8) — partition degree 8 when an 8-block is free,
+    decline otherwise (docs/results_round5/rule_extraction.md; 12,672
+    dumped decisions agree at 100%). One held-out episode suffices to
+    catch a drifted checkpoint or a broken actor."""
+    from ddls_tpu.config import load_config
+    from ddls_tpu.envs.baselines import FixedDegreePacking
+    from ddls_tpu.rl.rollout import stack_obs
+    from ddls_tpu.train import make_epoch_loop
+    from train_from_config import build_epoch_loop_kwargs
+
+    cfg = load_config(os.path.join(REPO, "scripts",
+                                   "ramp_job_partitioning_configs"),
+                      "rllib_config",
+                      ["env_config=env_load32",
+                       ("env_config.jobs_config.job_interarrival_time_dist"
+                        "._target_=ddls_tpu.demands.distributions.Fixed"),
+                       ("env_config.jobs_config."
+                        "job_interarrival_time_dist.val=80.0")])
+    kwargs = build_epoch_loop_kwargs(cfg)
+    kwargs["num_envs"] = 1
+    kwargs["rollout_length"] = 1
+    kwargs["evaluation_interval"] = None
+    loop = make_epoch_loop("ppo", **kwargs)
+    actor = FixedDegreePacking(degree=8)
+    try:
+        loop.load_agent_checkpoint(os.path.join(REPO, "checkpoints",
+                                                "ppo_device_trained"))
+        env = loop.make_eval_env()
+        obs = env.reset(seed=7009)
+        done, checked = False, 0
+        while not done:
+            a_pol = int(loop._greedy_actions(stack_obs([obs]))[0])
+            a_rule = actor.compute_action(obs)
+            assert a_pol == a_rule, (checked, a_pol, a_rule)
+            obs, _, done, _ = env.step(a_pol)
+            checked += 1
+    finally:
+        loop.close()
+    assert checked > 100
