@@ -1,0 +1,105 @@
+"""Worker for the 2-process multi-host x device_collector test
+(VERDICT r4 item 6).
+
+Each process joins a global gloo mesh and runs TWO full epochs of PPO
+whose collection happens entirely in the jitted env
+(`algo_config.device_collector: true`): per-process job banks (the
+collect seed is process-distinct, so banks and in-kernel episode
+histories genuinely diverge), per-process segment rngs, in-kernel
+episode resets — the new deterministic-gate hazard class — while the
+replicated parameters of the sharded update must end BIT-identical on
+every process.
+
+Prints machine-checkable lines: BANKS <sha1>, PARAMS <sha1>.
+"""
+import hashlib
+import sys
+
+sys.path.insert(0, sys.argv[4] if len(sys.argv) > 4 else ".")
+
+from ddls_tpu.parallel import initialize_distributed
+
+
+def main() -> int:
+    coordinator, num_processes, process_id = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    initialize_distributed(coordinator_address=coordinator,
+                           num_processes=num_processes,
+                           process_id=process_id, platform="cpu")
+    import jax
+    import numpy as np
+
+    from ddls_tpu.train.loops import RLEpochLoop
+
+    env_config = {
+        "topology_config": {"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        "node_config": {"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        "jobs_config": {
+            # identical synthetic dataset on every process (env CONFIG is
+            # process-identical); bank CONTENTS diverge via the
+            # process-distinct collect seed
+            "synthetic": {"n_cnn": 1, "n_translation": 1, "seed": 6,
+                          "min_ops": 6, "max_ops": 8},
+            "path_to_files": None,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 40.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.1, "max_val": 0.6, "decimals": 2},
+            "replication_factor": 20,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 20},
+        "max_partitions_per_op": 4,
+        "min_op_run_time_quantum": 0.01,
+        "reward_function": "job_acceptance",
+        "max_simulation_run_time": 2e3,
+        "pad_obs_kwargs": {"max_nodes": 32, "max_edges": 64},
+    }
+    model = {"fcnet_hiddens": [16], "custom_model_config": {
+        "out_features_msg": 4, "out_features_hidden": 8,
+        "out_features_node": 4, "out_features_graph": 4}}
+    algo_config = {"lr": 1e-3, "num_sgd_iter": 2,
+                   "sgd_minibatch_size": 8, "train_batch_size": 16,
+                   "device_collector": True}
+
+    loop = RLEpochLoop(
+        path_to_env_cls="ddls_tpu.envs.partitioning_env."
+                        "RampJobPartitioningEnvironment",
+        env_config=env_config, model=model, algo_config=algo_config,
+        num_envs=2, rollout_length=8, use_parallel_envs=False,
+        evaluation_interval=None, seed=0)
+    for _ in range(2):
+        results = loop.run()
+    assert results["epoch_counter"] == 2, results
+
+    # process-divergence evidence: the per-process job banks must differ
+    # (the whole point of process-distinct collect seeds)
+    hb = hashlib.sha1()
+    for leaf in jax.tree_util.tree_leaves(
+            jax.device_get(loop.collector.banks)):
+        hb.update(np.ascontiguousarray(leaf).tobytes())
+    print(f"BANKS process={process_id} digest={hb.hexdigest()}",
+          flush=True)
+
+    # parameters must be BIT-identical across processes
+    h = hashlib.sha1()
+    for leaf in jax.tree_util.tree_leaves(
+            jax.device_get(loop.state.params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    print(f"PARAMS process={process_id} digest={h.hexdigest()}",
+          flush=True)
+    loop.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -124,3 +124,28 @@ def test_four_process_real_epoch_bit_identical_params():
     assert len(set(digests)) == 1, f"params diverged across hosts: {digests}"
     # the hazard actually exercised: processes saw different env histories
     assert len(set(blocked)) >= 2, f"env histories identical: {blocked}"
+
+
+def test_two_process_device_collector_bit_identical_params():
+    """VERDICT r4 item 6: multi-host x device_collector. Each of 2 gloo
+    processes collects fixed-length segments in the jitted env on its
+    OWN per-process job banks (banks must differ — asserted), runs the
+    sharded update over the global mesh, and the replicated parameters
+    must end BIT-identical (in-kernel resets/done gates are the new
+    deterministic-gate hazard class)."""
+    worker = os.path.join(REPO, "tests", "_distributed_device_worker.py")
+    coordinator = f"localhost:{_free_port()}"
+    procs, outputs = _run_lockstep(
+        [[sys.executable, worker, coordinator, "2", str(i), REPO]
+         for i in range(2)], timeout=600)
+    params, banks = [], []
+    for i, (proc, out) in enumerate(zip(procs, outputs)):
+        assert proc.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        for line in out.splitlines():
+            if line.startswith(f"PARAMS process={i} "):
+                params.append(line.split("digest=")[1].strip())
+            if line.startswith(f"BANKS process={i} "):
+                banks.append(line.split("digest=")[1].strip())
+    assert len(params) == 2, outputs
+    assert len(set(params)) == 1, f"params diverged across hosts: {params}"
+    assert len(set(banks)) == 2, "per-process banks were identical"
