@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-5 TPU runbook (VERDICT r4 item 3): if the tunnel revives, this
+# banks the "trained END-TO-END on silicon at headline level" claim.
+#
+# Run it ONLY after a probe shows the tunnel alive
+# (tail .probe/probe_loop.log). It:
+#   1. atomically takes .probe/tpu.lock so the probe loop can't open a
+#      second client (the documented wedge trigger) — and refuses to run
+#      if another owner holds it,
+#   2. warm-starts the shipped device-collected policy
+#      (checkpoints/ppo_device_trained, already at headline level from
+#      CPU-backend training) for 200 device_collector epochs at the
+#      2x128 shape that compiles reliably through the tunnel,
+#   3. releases the lock, then held-out-evaluates the EVAL-TRACKED BEST
+#      checkpoint on CPU (checkpoint selection is load-bearing —
+#      RESULTS.md r4 §4: the final checkpoint decays; the convergence
+#      claim needs no silicon, only the "trained on silicon" part does).
+#
+# Wedge discipline (VERDICT r4 item 1): do NOT kill a mid-compile
+# client; if the run must stop, wait for an epoch boundary. Run no
+# other kill-prone compiles while this owns the chip.
+set -uo pipefail
+cd "$(dirname "$0")/../.." || exit 1
+ROOT=$(pwd)
+
+OUT=.experiments/r5_tpu_device_$(date -u +%Y%m%dT%H%M%S)
+mkdir -p "$OUT" .probe
+
+# atomic lock: fail rather than clobber another owner's lock
+if ! (set -o noclobber; : > .probe/tpu.lock) 2>/dev/null; then
+    echo "ABORT: .probe/tpu.lock already held (bench/training owns the" \
+         "chip); two concurrent axon clients is the wedge trigger" >&2
+    exit 1
+fi
+trap 'rm -f .probe/tpu.lock' EXIT
+
+python scripts/train_from_config.py \
+  env_config=env_load32 \
+  algo=ppo \
+  algo.algo_config.device_collector=true \
+  epoch_loop.num_envs=2 epoch_loop.rollout_length=128 \
+  epoch_loop.initial_checkpoint_path=checkpoints/ppo_device_trained \
+  eval_config.evaluation_interval=25 eval_config.evaluation_duration=2 \
+  launcher.num_epochs=200 \
+  experiment.path_to_save="$OUT" \
+  2>&1 | tee "$OUT/train.log"
+rc=$?
+
+rm -f .probe/tpu.lock
+trap - EXIT
+if [ "$rc" -ne 0 ]; then
+    echo "ABORT: training exited rc=$rc; not evaluating" >&2
+    exit "$rc"
+fi
+
+# eval-tracked best checkpoint (train_from_config prints
+# "Best checkpoint: <path> (metric=...)"); fall back to the highest
+# epoch only if the log carries none, and say so
+BEST=$(sed -n 's/^Best checkpoint: \([^ ]*\) .*/\1/p' "$OUT/train.log" \
+       | tail -1)
+[ "$BEST" = "None" ] && BEST=""
+if [ -z "$BEST" ]; then
+    echo "WARNING: no best_checkpoint_path in train.log; falling back" \
+         "to the FINAL checkpoint (known to decay — treat with care)" >&2
+    BEST=$(ls -d "$OUT"/*/*/checkpoints/checkpoint_* 2>/dev/null \
+           | sort -V | tail -1)
+fi
+if [ -z "$BEST" ]; then
+    echo "ABORT: no checkpoint found under $OUT" >&2
+    exit 1
+fi
+case "$BEST" in /*) ;; *) BEST="$ROOT/$BEST" ;; esac
+echo "evaluating $BEST"
+
+# the policy is obs-only, so use the plain-obs eval path — extract_rule's
+# dump prints per-seed returns AND the decision dump to check which
+# FixedDegree the silicon-trained policy implements
+cd scripts/experiments || exit 1
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+  python extract_rule.py dump "$BEST" "$ROOT/$OUT/tpu_trained_eval.npz" \
+  --loads 50 --seeds 7001-7008 2>&1 | tee "$ROOT/$OUT/eval.log"
