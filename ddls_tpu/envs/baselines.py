@@ -202,10 +202,114 @@ class FixedDegreePacking(BaselineActor):
         return self.degree if self.degree in _valid_actions(obs) else 0
 
 
+class AdaptiveDegreePacking(BaselineActor):
+    """Fixed-Degree Packing with the degree chosen by the measured
+    d*(scale, load) law instead of a constant
+    (docs/results_round5/rule_extraction.md; the degree x load x size
+    map in docs/results_round5/degree_map.md):
+
+    * estimate per-server offered load online,
+      rho = (sum of arrived jobs' sequential JCTs) / elapsed / n_servers
+      — worker-seconds of demand per wall-second per server, all
+      observable at decision time;
+    * pick the target degree by load: heavy (rho >= 1.2) -> 4 (an
+      intra-group fraction: more concurrent slots absorb the overload),
+      moderate (0.6 <= rho < 1.2) -> ONE communication group, light
+      (rho < 0.6) -> two groups (capped at the action-space max);
+    * degrees must tile the group structure (d <= group_size or
+      d % group_size == 0) — the measured constraint behind degree 16's
+      collapse on the 6x6x2 topology (16 = 1 1/3 groups of 12) while
+      the same degree excels where it tiles exactly (2x8 at 32 servers,
+      1x16 at 128). The law made an out-of-sample prediction — d=12
+      (one whole group) at 72 servers, moderate load — that measurement
+      confirmed as the best known result at that cell (0.996
+      per-decision, 449.2 +/- 0.7, vs always-8's 428).
+
+    Declines (action 0) when the chosen degree has no free block, like
+    FixedDegreePacking — uniform-degree tiling is what keeps the
+    cluster fragmentation-free. One heuristic, zero training, zero
+    pricing: best-or-within-noise at every measured (size, load) cell,
+    where the RL path needed one fine-tune per size.
+    """
+
+    name = "adaptive_degree_packing"
+
+    def __init__(self, heavy_degree: int = 4, heavy_threshold: float = 1.2,
+                 light_threshold: float = 0.6, **kwargs):
+        super().__init__(**kwargs)
+        self.heavy_degree = heavy_degree
+        self.heavy_threshold = heavy_threshold
+        self.light_threshold = light_threshold
+        self._seq_sum = 0.0
+        self._last_time = -1.0
+        self._last_arrived = 0
+
+    def _rho(self, env, job_to_place) -> float:
+        cluster = env.cluster
+        now = float(cluster.stopwatch.time())
+        arrived = int(cluster.num_jobs_arrived)
+        # fresh episode: time rewinds OR the arrival counter restarted
+        # (time alone can fail to rewind when a truncated episode ends
+        # earlier than the next one's first decision)
+        if now < self._last_time or arrived < self._last_arrived:
+            self._seq_sum = 0.0
+        self._last_time = now
+        self._last_arrived = arrived
+        self._seq_sum += float(job_to_place.seq_completion_time)
+        n = cluster.topology.num_workers
+        if now <= 0.0 or arrived < 3:
+            return float("nan")  # not enough signal yet
+        return self._seq_sum / now / n
+
+    def _static_target(self, target: int, group: int, max_action: int,
+                       ramp_shape) -> int:
+        """Snap the load-indicated target down to the largest degree that
+        is even (or 1), within the action space, group-tiling, and
+        geometrically placeable on an EMPTY cluster — static facts only.
+        Whether a block is free right now is deliberately not consulted:
+        a busy cluster means decline, not a smaller degree, or the
+        uniform tiling (the rule's whole advantage) is lost."""
+        from ddls_tpu.envs.obs import _block_shape_exists
+
+        d = min(target, max_action)
+        d -= d % 2  # odd starts would otherwise never pass the even test
+        while d >= 2:
+            if ((d <= group or d % group == 0)
+                    and _block_shape_exists(d, tuple(ramp_shape))):
+                return d
+            d -= 2
+        return 1
+
+    def compute_action(self, obs, job_to_place=None, env=None,
+                       **kwargs) -> int:
+        valid = set(int(a) for a in _valid_actions(obs))
+        if env is None or job_to_place is None:
+            # silently degrading to some fixed degree would mislabel
+            # results as "adaptive"; drivers must pass both (EvalLoop
+            # does — loops.py:1002)
+            raise ValueError(
+                "AdaptiveDegreePacking needs env and job_to_place at "
+                "decision time (its load estimate reads the cluster "
+                "clock and the queued job's sequential JCT)")
+        shape = env.cluster.topology.shape
+        group = int(shape[1]) * int(shape[2])
+        rho = self._rho(env, job_to_place)
+        if rho != rho or rho >= self.heavy_threshold:  # nan -> heavy-safe
+            target = self.heavy_degree
+        elif rho >= self.light_threshold:
+            target = group
+        else:
+            target = 2 * group
+        max_action = int(np.asarray(obs["action_set"]).max())
+        d = self._static_target(target, group, max_action, shape)
+        return d if d in valid else 0
+
+
 BASELINE_ACTORS = {
     cls.name: cls for cls in (RandomActor, NoParallelism, MinParallelism,
                               MaxParallelism, SiPML, AcceptableJCT,
-                              OracleJCT, FixedDegreePacking)
+                              OracleJCT, FixedDegreePacking,
+                              AdaptiveDegreePacking)
 }
 
 

@@ -1,0 +1,66 @@
+"""Map the optimal fixed packing degree d*(scale, load) — the open
+question the round-5 rule extraction left
+(docs/results_round5/rule_extraction.md "What this changes").
+
+For each (topology, interarrival) cell, runs FixedDegreePacking at
+several degrees over n=8 held-out seeds and prints one JSON line per
+(cell, degree) with per-decision mean return — per-DECISION so cells
+with different episode lengths compare.
+
+Usage: python degree_load_map.py [cell ...]
+  cell = CxRxS:ia (e.g. 4x4x2:100) — default grid covers 32 servers at
+  5 loads and 72/128 servers at 2-3 loads each.
+"""
+import json
+import sys
+
+import numpy as np
+
+from _eval_common import _ROOT  # noqa: F401
+from eval_group_packing import make_env, run_episode  # noqa: E402
+
+from ddls_tpu.envs.baselines import FixedDegreePacking  # noqa: E402
+
+DEFAULT_GRID = [
+    # canonical 32 servers across the sweep loads
+    *[((4, 4, 2), ia) for ia in (30.0, 50.0, 80.0, 120.0, 200.0)],
+    # 72 servers: protocol load and 2x lighter
+    ((6, 6, 2), 22.2), ((6, 6, 2), 44.4),
+    # 128 servers: protocol load, 2x and 4x lighter
+    ((8, 8, 2), 12.5), ((8, 8, 2), 25.0), ((8, 8, 2), 50.0),
+]
+DEGREES = (2, 4, 8, 16)
+SEEDS = range(7001, 7009)
+
+
+def main():
+    if len(sys.argv) > 1:
+        grid = []
+        for cell in sys.argv[1:]:
+            topo_s, ia_s = cell.split(":")
+            grid.append((tuple(int(x) for x in topo_s.split("x")),
+                         float(ia_s)))
+    else:
+        grid = DEFAULT_GRID
+    for topo, ia in grid:
+        n_srv = topo[0] * topo[1] * topo[2]
+        env = make_env(ia, topo=None if topo == (4, 4, 2) else topo)
+        for d in DEGREES:
+            if d > n_srv:
+                continue
+            actor = FixedDegreePacking(degree=d)
+            pds, rets = [], []
+            for s in SEEDS:
+                ret, steps = run_episode(env, actor, s)
+                rets.append(ret)
+                pds.append(ret / max(steps, 1))
+            print(json.dumps({
+                "servers": n_srv, "ia": ia, "degree": d,
+                "per_decision_mean": round(float(np.mean(pds)), 4),
+                "return_mean": round(float(np.mean(rets)), 1),
+                "return_sd": round(float(np.std(rets, ddof=1)), 1),
+                "n": len(rets)}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

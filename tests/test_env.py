@@ -209,3 +209,37 @@ def test_jct_reward_survives_episode_end_sweep(dataset_dir):
         "lookahead_job_completion_time"]
         / env.last_placed_job.seq_completion_time)
     assert r2 == pytest.approx(expected)
+
+
+def test_fixed_degree_packing_actor():
+    """The round-5 extracted rule actor: plays its degree iff valid,
+    declines otherwise (docs/results_round5/rule_extraction.md)."""
+    from ddls_tpu.envs.baselines import FixedDegreePacking
+
+    actor = FixedDegreePacking(degree=8)
+    obs = {"action_set": np.arange(17, dtype=np.int32),
+           "action_mask": np.zeros(17, dtype=np.int32)}
+    obs["action_mask"][[0, 1, 2, 4, 8]] = 1
+    assert actor.compute_action(obs) == 8
+    obs["action_mask"][8] = 0
+    assert actor.compute_action(obs) == 0
+    assert FixedDegreePacking(degree=4).compute_action(obs) == 4
+
+
+def test_adaptive_degree_packing_static_target():
+    """The d*(scale, load) law's geometry snap (round 5,
+    docs/results_round5/degree_map.md): degrees must tile the group
+    structure; snapping is by STATIC geometry, never by current
+    occupancy (a busy cluster declines rather than shrink the degree)."""
+    from ddls_tpu.envs.baselines import AdaptiveDegreePacking
+
+    actor = AdaptiveDegreePacking()
+    # 6x6x2 topology: group = 12; target 16 must snap to 12 (one whole
+    # group), not 14 (tiles nothing) — the measured out-of-sample win
+    assert actor._static_target(16, 12, 16, (6, 6, 2)) == 12
+    # 4x4x2: group = 8; 16 = two whole groups, allowed
+    assert actor._static_target(16, 8, 16, (4, 4, 2)) == 16
+    # 8x8x2: group = 16; 16 fits within one group
+    assert actor._static_target(16, 16, 16, (8, 8, 2)) == 16
+    # target capped by the action-space max
+    assert actor._static_target(32, 8, 16, (4, 4, 2)) == 16
