@@ -22,20 +22,49 @@ import numpy as np
 class DevicePPOCollector:
     """Drop-in counterpart of `rl/rollout.py:RolloutCollector` whose envs
     live on device. ``banks`` is a dict of stacked job-bank arrays with a
-    leading B axis (same shapes per bank)."""
+    leading B axis (same shapes per bank).
 
-    def __init__(self, et, ot, model, banks: Dict, rollout_length: int):
+    With ``mesh`` (a 1-D+ ``jax.sharding.Mesh`` with a ``dp`` axis), the
+    lane axis is SHARDED over the mesh's dp devices: each device runs its
+    own lanes' episodes inside the one jitted dispatch (the vmapped scan
+    is embarrassingly parallel over lanes, so XLA partitions it with no
+    collectives). This is the pod collection shape — the update already
+    shards its batch over the same mesh, so without it a multi-chip
+    slice would collect on one chip and update on all. Requires
+    ``num_envs`` divisible by the dp axis size."""
+
+    def __init__(self, et, ot, model, banks: Dict, rollout_length: int,
+                 mesh=None):
         import jax
         import jax.numpy as jnp
 
         from ddls_tpu.sim.jax_env import make_segment_fn, segment_init
 
         self.et, self.ot, self.model = et, ot, model
-        self.banks = banks
         self.rollout_length = rollout_length
         self.num_envs = int(jax.tree_util.tree_leaves(banks)[0].shape[0])
+        self.mesh = mesh
         segment = make_segment_fn(et, ot, model, rollout_length)
-        self._vseg = jax.jit(jax.vmap(segment, in_axes=(0, None, 0, 0)))
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            if self.num_envs % mesh.shape["dp"] != 0:
+                raise ValueError(
+                    f"num_envs {self.num_envs} must divide over the "
+                    f"mesh dp axis ({mesh.shape['dp']})")
+            lane = NamedSharding(mesh, P("dp"))
+            repl = NamedSharding(mesh, P())
+            banks = jax.device_put(banks, lane)
+            # rngs/state arrive as host (or mismatched) arrays; jit's
+            # explicit in_shardings reshards them on dispatch
+            self._vseg = jax.jit(
+                jax.vmap(segment, in_axes=(0, None, 0, 0)),
+                in_shardings=(lane, repl, lane, lane),
+                out_shardings=(lane, lane, lane))
+        else:
+            self._vseg = jax.jit(jax.vmap(segment,
+                                          in_axes=(0, None, 0, 0)))
+        self.banks = banks
         # per-env initial state from each env's OWN bank (arrival clocks
         # differ across banks)
         self._state = jax.vmap(lambda b: segment_init(et, b))(banks)

@@ -334,6 +334,7 @@ class RLEpochLoop:
         in-kernel. Serves every loop that consumes the shared traj dict
         (ppo, impala, pg). Requires the canonical-RAMP jitted env
         (sim/jax_env.py) and a priceless observation."""
+        import jax
         import jax.numpy as jnp
 
         from ddls_tpu.rl.ppo_device import DevicePPOCollector
@@ -377,8 +378,30 @@ class RLEpochLoop:
                  for i in range(self.num_envs)]
         stacked = {k: jnp.asarray(np.stack([b[k] for b in banks]))
                    for k in banks[0]}
+        # shard lanes over LOCAL devices when they divide evenly (the
+        # pod collection shape: each chip runs its own lanes; without
+        # this a multi-chip slice collects on one chip and updates on
+        # all). Multi-process: a per-process LOCAL mesh keeps each
+        # process's banks/rngs its own (the global mesh would demand
+        # cross-process arrays) while still using every local chip
+        mesh = None
+        local = jax.local_devices()
+        if len(local) > 1:
+            if self.num_envs % len(local) == 0:
+                if jax.process_count() == 1:
+                    mesh = self.mesh
+                else:
+                    from ddls_tpu.parallel.mesh import make_mesh
+                    mesh = make_mesh(len(local), devices=local)
+            else:
+                import warnings
+                warnings.warn(
+                    f"device_collector: num_envs={self.num_envs} not "
+                    f"divisible by {len(local)} local devices; lanes "
+                    "will collect on ONE device (set num_envs to a "
+                    "multiple for sharded collection)")
         return DevicePPOCollector(et, ot, self.model, stacked,
-                                  self.rollout_length)
+                                  self.rollout_length, mesh=mesh)
 
     # ----------------------------------------------------------------- epoch
     def _split_rng(self):

@@ -179,3 +179,56 @@ def test_episode_records_from_traced_counters(setup):
     # the comparisons above are only meaningful if episodes actually
     # completed: 6 x 24 decisions vs ~33 arrivals/episode guarantees it
     assert total_records >= 1
+
+
+def test_mesh_sharded_lane_collection(setup):
+    """Lanes sharded over the 8-device dp mesh (the pod collection
+    shape): one jitted dispatch runs each device's lanes. Partitioned
+    compilation may differ from the single-device program at the last
+    f32 ulp, which can flip a sampled action — so the pin is structural
+    (lanes genuinely distributed, trajectories valid, episodes
+    harvested, the learner consumes the result), not bitwise."""
+    et, ot, model, params, _ = setup
+    from ddls_tpu.sim.jax_env import build_job_bank
+
+    def mk_bank(seed):
+        r = np.random.RandomState(seed)
+        recs = [{"model": et.types[int(r.randint(0, len(et.types)))],
+                 "num_training_steps": 10,
+                 "sla_frac": round(float(r.uniform(0.2, 1.0)), 2),
+                 "time_arrived": 60.0 * i} for i in range(30)]
+        return build_job_bank(et, recs)
+
+    banks8 = {k: jnp.asarray(np.stack([mk_bank(s)[k] for s in range(8)]))
+              for k in mk_bank(0)}
+    mesh = make_mesh(8)
+    collector = DevicePPOCollector(et, ot, model, banks8,
+                                   rollout_length=16, mesh=mesh)
+    # lanes genuinely live on 8 devices
+    lane_shard = jax.tree_util.tree_leaves(collector.banks)[0].sharding
+    assert len(lane_shard.device_set) == 8
+
+    learner = PPOLearner(
+        lambda p, o: batched_policy_apply(model, p, o),
+        PPOConfig(num_sgd_iter=2, sgd_minibatch_size=16), mesh)
+    state = learner.init_state(params)
+    n_eps = 0
+    for i in range(4):
+        out = collector.collect(state.params, jax.random.PRNGKey(40 + i))
+        traj = out["traj"]
+        assert traj["actions"].shape == (16, 8)
+        assert np.isfinite(traj["logp"]).all()
+        assert np.isfinite(traj["rewards"]).all()
+        n_eps += len(out["episodes"])
+        for e in out["episodes"]:
+            assert (e["num_jobs_arrived"]
+                    >= e["num_jobs_completed"] + e["num_jobs_blocked"])
+        straj, slv = learner.shard_traj(out["traj"], out["last_values"])
+        state, metrics = learner.train_step(
+            state, straj, slv, jax.random.PRNGKey(50 + i))
+        assert np.isfinite(float(metrics["total_loss"]))
+    assert n_eps >= 1  # 64 decisions/lane vs ~30-arrival banks
+
+    with pytest.raises(ValueError, match="must divide"):
+        DevicePPOCollector(et, ot, model, banks8, rollout_length=4,
+                           mesh=make_mesh(5))  # 8 lanes % 5 devices
