@@ -16,8 +16,39 @@ import sys
 
 import numpy as np
 
-from _eval_common import _ROOT  # noqa: F401
-from eval_group_packing import make_env, run_episode  # noqa: E402
+from _eval_common import _ROOT, CONFIG_PATH  # noqa: F401
+from eval_group_packing import run_episode  # noqa: E402
+from eval_group_packing import make_env as _make_env_acceptance  # noqa: E402
+
+
+def make_env(ia, topo=None, objective="acceptance"):
+    if objective == "acceptance":
+        return _make_env_acceptance(ia, topo=topo)
+    from ddls_tpu.config import load_config
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+
+    overrides = [
+        "env_config=env_load32",
+        ("env_config.jobs_config.job_interarrival_time_dist._target_="
+         "ddls_tpu.demands.distributions.Fixed"),
+        f"env_config.jobs_config.job_interarrival_time_dist.val={ia}",
+        "env_config.reward_function=multi_objective_jct_blocking",
+        "env_config.reward_function_kwargs.fail_reward=null",
+        "env_config.reward_function_kwargs.success_reward=null",
+    ]
+    if topo:
+        c, r, sv = topo
+        overrides += [
+            f"env_config.topology_config.kwargs.num_communication_groups={c}",
+            ("env_config.topology_config.kwargs."
+             f"num_racks_per_communication_group={r}"),
+            f"env_config.topology_config.kwargs.num_servers_per_rack={sv}",
+            f"env_config.node_config.type_1.num_nodes={c * r * sv}",
+        ]
+    cfg = load_config(CONFIG_PATH, "rllib_config", overrides)
+    env_cfg = {k: v for k, v in cfg["env_config"].items()
+               if k != "_target_"}
+    return RampJobPartitioningEnvironment(**env_cfg)
 
 from ddls_tpu.envs.baselines import FixedDegreePacking  # noqa: E402
 
@@ -34,6 +65,10 @@ SEEDS = range(7001, 7009)
 
 
 def main():
+    objective = "acceptance"
+    if "--objective=jct" in sys.argv:
+        sys.argv.remove("--objective=jct")
+        objective = "jct"
     if len(sys.argv) > 1:
         grid = []
         for cell in sys.argv[1:]:
@@ -44,7 +79,8 @@ def main():
         grid = DEFAULT_GRID
     for topo, ia in grid:
         n_srv = topo[0] * topo[1] * topo[2]
-        env = make_env(ia, topo=None if topo == (4, 4, 2) else topo)
+        env = make_env(ia, topo=None if topo == (4, 4, 2) else topo,
+                       objective=objective)
         for d in DEGREES:
             if d > n_srv:
                 continue
@@ -56,6 +92,7 @@ def main():
                 pds.append(ret / max(steps, 1))
             print(json.dumps({
                 "servers": n_srv, "ia": ia, "degree": d,
+                "objective": objective,
                 "per_decision_mean": round(float(np.mean(pds)), 4),
                 "return_mean": round(float(np.mean(rets)), 1),
                 "return_sd": round(float(np.std(rets, ddof=1)), 1),
