@@ -215,7 +215,11 @@ class AdaptiveDegreePacking(BaselineActor):
     * pick the target degree by load: heavy (rho >= 1.2) -> 4 (an
       intra-group fraction: more concurrent slots absorb the overload),
       moderate (0.6 <= rho < 1.2) -> ONE communication group, light
-      (rho < 0.6) -> two groups (capped at the action-space max);
+      (rho < 0.6) -> two groups (capped at the action-space max).
+      Under ``objective="jct"`` the heavy target defaults to 8 instead
+      of 4 — the measured JCT-objective map shifts every
+      acceptance-heavy cell one tier up while the geometry stays
+      objective-independent (an explicit ``heavy_degree`` overrides);
     * degrees must tile the group structure (d <= group_size or
       d % group_size == 0) — the measured constraint behind degree 16's
       collapse on the 6x6x2 topology (16 = 1 1/3 groups of 12) while
@@ -234,9 +238,25 @@ class AdaptiveDegreePacking(BaselineActor):
 
     name = "adaptive_degree_packing"
 
-    def __init__(self, heavy_degree: int = 4, heavy_threshold: float = 1.2,
-                 light_threshold: float = 0.6, **kwargs):
+    def __init__(self, heavy_degree: int = None,
+                 heavy_threshold: float = 1.2,
+                 light_threshold: float = 0.6,
+                 objective: str = "acceptance", **kwargs):
         super().__init__(**kwargs)
+        # the geometry half of the law is objective-independent; the
+        # load half shifts one tier toward larger degrees under the
+        # JCT-blocking reward family (measured map:
+        # docs/results_round5/degree_map.md "Scope limit") — every
+        # acceptance-heavy d=4 cell becomes d=8 because accepted jobs'
+        # JCT ratios enter the return directly. An explicit
+        # heavy_degree always wins (ablations must stay expressible)
+        if objective not in ("acceptance", "jct"):
+            raise ValueError(
+                f"unknown objective {objective!r}: expected "
+                "'acceptance' or 'jct'")
+        if heavy_degree is None:
+            heavy_degree = 8 if objective == "jct" else 4
+        self.objective = objective
         self.heavy_degree = heavy_degree
         self.heavy_threshold = heavy_threshold
         self.light_threshold = light_threshold

@@ -243,3 +243,15 @@ def test_adaptive_degree_packing_static_target():
     assert actor._static_target(16, 16, 16, (8, 8, 2)) == 16
     # target capped by the action-space max
     assert actor._static_target(32, 8, 16, (4, 4, 2)) == 16
+
+
+def test_adaptive_degree_packing_jct_objective():
+    """Objective-aware tier shift (docs/results_round5/degree_map.md):
+    under the JCT reward family the heavy-load target is 8, not 4; the
+    group-tiling geometry is objective-independent."""
+    from ddls_tpu.envs.baselines import AdaptiveDegreePacking
+
+    assert AdaptiveDegreePacking(objective="jct").heavy_degree == 8
+    assert AdaptiveDegreePacking().heavy_degree == 4
+    with pytest.raises(ValueError):
+        AdaptiveDegreePacking(objective="latency")
