@@ -253,5 +253,9 @@ def test_adaptive_degree_packing_jct_objective():
 
     assert AdaptiveDegreePacking(objective="jct").heavy_degree == 8
     assert AdaptiveDegreePacking().heavy_degree == 4
+    # explicit heavy_degree wins (the d=4-under-JCT ablation must stay
+    # expressible)
+    assert AdaptiveDegreePacking(heavy_degree=4,
+                                 objective="jct").heavy_degree == 4
     with pytest.raises(ValueError):
         AdaptiveDegreePacking(objective="latency")
