@@ -159,8 +159,6 @@ def _dataset_pad_bounds(dataset_dir: str) -> dict:
     through every GNN forward AND backward of the update (~10x dead rows at
     this dataset's 30-op bound), without changing a single output bit —
     padded rows are fully masked (docs/perf_round5.md)."""
-    if dataset_dir in _PAD_BOUNDS_CACHE:
-        return _PAD_BOUNDS_CACHE[dataset_dir]
     import glob
 
     from ddls_tpu.graphs.readers import read_graph_file
@@ -170,13 +168,23 @@ def _dataset_pad_bounds(dataset_dir: str) -> dict:
         # max_nodes=0 would read as "padding disabled" downstream and break
         # obs stacking with a far-away shape error; fail at the source
         raise FileNotFoundError(f"no *.txt graph files in {dataset_dir}")
+    # cache key carries a cheap content fingerprint (file count + names +
+    # mtimes), not the path alone: a dataset regenerated in-process at the
+    # same path with different graph sizes must not serve stale bounds
+    # (ADVICE r5 item 4 — the failure would surface as a far-away obs
+    # stacking shape error, or silent over/under-padding)
+    key = (dataset_dir, len(paths),
+           tuple((os.path.basename(p), os.stat(p).st_mtime_ns)
+                 for p in paths))
+    if key in _PAD_BOUNDS_CACHE:
+        return _PAD_BOUNDS_CACHE[key]
     max_ops = max_deps = 0
     for path in paths:
         g = read_graph_file(path)
         max_ops = max(max_ops, g.n_ops)
         max_deps = max(max_deps, g.n_deps)
     bounds = {"max_nodes": max_ops, "max_edges": max_deps}
-    _PAD_BOUNDS_CACHE[dataset_dir] = bounds
+    _PAD_BOUNDS_CACHE[key] = bounds
     return bounds
 
 
@@ -372,6 +380,167 @@ def run_jaxenv_bench(args) -> dict:
     }
 
 
+def _serve_obs_pool(dataset_dir: str, n_obs: int) -> list:
+    """Real encoded observations for the serving bench: step one env with
+    random valid actions and snapshot each decision's obs (the arriving
+    population a deployed server would see)."""
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+
+    env = RampJobPartitioningEnvironment(**make_env_kwargs(dataset_dir))
+    obs = env.reset(seed=0)
+    rng = np.random.RandomState(0)
+    pool = []
+    while len(pool) < n_obs:
+        pool.append({k: np.copy(v) for k, v in obs.items()})
+        valid = np.flatnonzero(np.asarray(obs["action_mask"]))
+        obs, _, done, _ = env.step(int(rng.choice(valid)))
+        if done:
+            obs = env.reset(seed=len(pool))
+    return pool
+
+
+def run_serve_bench(args) -> dict:
+    """Online-serving throughput/latency at configurable offered load
+    (ISSUE 1): Poisson arrivals drive ddls_tpu.serve.PolicyServer —
+    bucketed padding, deadline microbatching, one fixed-shape jitted
+    forward per bucket, FixedDegreePacking fallback under saturation. The
+    real-time loop submits each request at its arrival instant and pumps
+    the server, so reported latency is true wall latency (queue wait +
+    batch fill + forward), not just device time.
+
+    Measures the serving half of the stack the way ``loop_efficiency``
+    measures the training half: decisions/sec against the offered load,
+    with p50/p99 latency, batch occupancy, and fallback rate riding in
+    the same JSON line (BASELINE.md "Serving throughput")."""
+    import jax
+
+    from ddls_tpu.models.policy import GNNPolicy
+    from ddls_tpu.serve import PolicyServer, default_buckets
+
+    dataset_dir = _make_dataset()
+    bounds = _dataset_pad_bounds(dataset_dir)
+    pool = _serve_obs_pool(dataset_dir, min(64, args.serve_requests))
+    n_actions = int(np.asarray(pool[0]["action_mask"]).shape[0])
+
+    pool_graph_dim = int(np.asarray(pool[0]["graph_features"]).shape[0])
+    if args.serve_checkpoint:
+        # checkpoint-faithful architecture: the shipped checkpoints carry
+        # algo-level model overrides (fcnet_hiddens), so the model must be
+        # rebuilt from the training config tree or the restore cannot load
+        from ddls_tpu.serve import (build_model_from_config,
+                                    checkpoint_graph_feature_dim,
+                                    load_checkpoint_params)
+
+        model, cfg_actions, graph_dim = build_model_from_config(
+            args.serve_config_path, args.serve_config_name,
+            args.serve_override)
+        if cfg_actions != n_actions or graph_dim != pool_graph_dim:
+            raise ValueError(
+                f"--serve-checkpoint config expects obs widths "
+                f"(actions={cfg_actions}, graph={graph_dim}) but the "
+                f"bench env emits ({n_actions}, {pool_graph_dim}); pass "
+                f"a matching --serve-config-name/--serve-override")
+        params = load_checkpoint_params(args.serve_checkpoint)
+        # the config matching the bench env does not make the CHECKPOINT
+        # match: restore is target-free, so e.g. the 51-wide price-trained
+        # ppo_price_mixed params would load under the 34-wide default
+        # config and fail the first warmup forward with a raw XLA shape
+        # error; reject the pairing here with its actual cause instead
+        ckpt_dim = checkpoint_graph_feature_dim(params)
+        if ckpt_dim is not None and ckpt_dim != graph_dim:
+            raise ValueError(
+                f"checkpoint {args.serve_checkpoint} was trained at "
+                f"graph width {ckpt_dim} but the serve config builds "
+                f"{graph_dim}; pass the checkpoint's training config "
+                f"(--serve-config-name/--serve-override)")
+        params_source = args.serve_checkpoint
+    else:
+        model = GNNPolicy(n_actions=n_actions)
+        graph_dim = pool_graph_dim
+        # random init: serving cost is architecture+shape-bound, not
+        # value-bound, so the smoke number needs no trained artifact
+        params = model.init(jax.random.PRNGKey(0),
+                            jax.tree_util.tree_map(np.asarray, pool[0]))
+        params_source = "random_init"
+
+    buckets = default_buckets(bounds["max_nodes"], bounds["max_edges"])
+    server = PolicyServer(model, params, buckets=buckets,
+                          max_batch=args.serve_max_batch,
+                          deadline_s=args.serve_deadline_ms / 1e3,
+                          max_queue=args.serve_max_queue,
+                          graph_feature_dim=graph_dim)
+
+    # compile every bucket before timing (each bucket compiles exactly
+    # once; the compile belongs to startup, not to steady-state latency)
+    for spec_idx in range(len(server.bucketer.buckets)):
+        for o in pool:
+            n = int(np.asarray(o["node_split"]).reshape(-1)[0])
+            m = int(np.asarray(o["edge_split"]).reshape(-1)[0])
+            if server.bucketer.bucket_index(n, m) == spec_idx:
+                server.submit(o)
+                server.drain()
+                break
+    server.stats = type(server.stats)()  # reset counters post-warmup
+
+    rng = np.random.RandomState(1)
+    n = args.serve_requests
+    arrivals = np.cumsum(rng.exponential(1.0 / args.serve_rps, size=n))
+    responses = []
+    start = time.perf_counter()
+    i = 0
+    while len(responses) < n:
+        now = time.perf_counter()
+        while i < n and now - start >= arrivals[i]:
+            # charge latency (and the deadline clock) from the ARRIVAL
+            # instant, not the submit-loop instant: arrivals that land
+            # while the loop is blocked in a device forward must still pay
+            # that wait, or p50/p99 are biased low exactly in overload
+            # (classic coordinated omission)
+            server.submit(pool[i % len(pool)], now=start + arrivals[i])
+            i += 1
+            now = time.perf_counter()
+        responses.extend(server.poll())
+        if len(responses) >= n:
+            break
+        # sleep to the next event (arrival or batch deadline), never long
+        next_events = [start + arrivals[i]] if i < n else []
+        deadline = server.next_deadline()
+        if deadline is not None:
+            next_events.append(deadline)
+        if next_events:
+            time.sleep(min(max(min(next_events) - time.perf_counter(), 0.0),
+                           0.005))
+        elif i >= n:
+            responses.extend(server.drain())
+    elapsed = time.perf_counter() - start
+
+    s = server.stats.summary()
+    return {
+        "metric": "serve_decisions_per_sec",
+        "value": round(len(responses) / elapsed, 2),
+        "unit": "decisions/s",
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "platform": jax.devices()[0].platform,
+        "p50_latency_ms": (round(s["p50_latency_ms"], 3)
+                           if s["p50_latency_ms"] is not None else None),
+        "p99_latency_ms": (round(s["p99_latency_ms"], 3)
+                           if s["p99_latency_ms"] is not None else None),
+        "batch_occupancy": (round(s["batch_occupancy"], 3)
+                            if s["batch_occupancy"] is not None else None),
+        "fallback_rate": round(s["fallback_rate"], 4),
+        "bucket_hits": s["bucket_hits"],
+        "n_compiles": s["n_compiles"],
+        "offered_rps": args.serve_rps,
+        "num_requests": n,
+        "max_batch": args.serve_max_batch,
+        "deadline_ms": args.serve_deadline_ms,
+        "buckets": [list(b) for b in buckets],
+        "params_source": params_source,
+        "cores": _available_cores(),
+    }
+
+
 def run_bench(args, platform_note: str | None,
               process_start: float) -> dict:
     import jax
@@ -436,9 +605,11 @@ def run_bench(args, platform_note: str | None,
 
     rng = jax.random.PRNGKey(1)
     update_args = None
+    warmup_completed = 0
     for i in range(args.warmup_epochs):
         rng, sub = jax.random.split(rng)
         state, _, update_args = one_epoch(state, sub)
+        warmup_completed += 1
         # warmup must leave room for >=1 timed epoch + the JSON emit (the
         # probe may already have burned its timeout against a wedged TPU);
         # a short warmup only biases the smoke number slow, never kills it
@@ -483,6 +654,12 @@ def run_bench(args, platform_note: str | None,
         "rollout_length": args.rollout_length,
         "num_sgd_iter": args.num_sgd_iter,
         "timed_epochs": epochs_run,
+        # the early-break above can cut warmup short of the ~320 steps/env
+        # the CPU smoke sizing targets; recording the achieved count makes
+        # a transient-contaminated number distinguishable from steady
+        # state (ADVICE r5 item 3)
+        "warmup_epochs_completed": warmup_completed,
+        "warmup_epochs_target": args.warmup_epochs,
         "cores": _available_cores(),
     }
     if platform_note:
@@ -549,14 +726,60 @@ def run_bench(args, platform_note: str | None,
     return payload
 
 
+def _run_probed_mode(args, runner, metric: str, unit: str) -> int:
+    """Accelerator-mode dispatch (jaxenv/serve): bounded backend probe
+    with CPU fallback, then run + emit exactly one JSON line whatever
+    happens."""
+    platform_note = None
+    err = probe_backend(args.probe_timeout)
+    if err is not None:
+        platform_note = f"default backend unusable ({err}); cpu"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        payload = runner(args)
+        if platform_note:
+            payload["platform_note"] = platform_note
+        emit(payload)
+        return 0
+    except Exception:
+        tb = traceback.format_exc().strip().splitlines()
+        emit({"metric": metric, "value": None, "unit": unit,
+              "vs_baseline": None, "error": " | ".join(tb[-3:])})
+        return 1
+
+
 def main(argv=None) -> int:
     process_start = time.perf_counter()
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", choices=("ppo", "sim", "jaxenv"),
+    parser.add_argument("--mode", choices=("ppo", "sim", "jaxenv", "serve"),
                         default="ppo",
                         help="ppo: full train loop; sim: pure env "
-                             "stepping; jaxenv: fully-jitted episodes")
+                             "stepping; jaxenv: fully-jitted episodes; "
+                             "serve: online policy serving at offered "
+                             "load (ddls_tpu/serve)")
     parser.add_argument("--jaxenv-max-degree", type=int, default=8)
+    parser.add_argument("--serve-requests", type=int, default=256)
+    parser.add_argument("--serve-rps", type=float, default=200.0,
+                        help="offered load (Poisson arrivals/sec)")
+    parser.add_argument("--serve-max-batch", type=int, default=8)
+    parser.add_argument("--serve-deadline-ms", type=float, default=5.0)
+    parser.add_argument("--serve-max-queue", type=int, default=64)
+    parser.add_argument("--serve-checkpoint", default=None,
+                        help="serve a shipped checkpoint's params instead "
+                             "of random init")
+    parser.add_argument("--serve-config-path",
+                        default=os.path.join(
+                            os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "ramp_job_partitioning_configs"),
+                        help="training config tree for the checkpoint's "
+                             "model architecture")
+    parser.add_argument("--serve-config-name", default="rllib_config")
+    parser.add_argument("--serve-override", action="append", default=[],
+                        help="serve config override, e.g. "
+                             "env_config=env_load32 (repeatable)")
     parser.add_argument("--num-envs", type=int, default=None)
     parser.add_argument("--rollout-length", type=int, default=32)
     parser.add_argument("--timed-epochs", type=int, default=3)
@@ -586,26 +809,15 @@ def main(argv=None) -> int:
     if args.mode == "jaxenv":
         # uses whatever backend is alive (the point IS the accelerator);
         # probe first so a wedged tunnel still yields a JSON line
-        platform_note = None
-        err = probe_backend(args.probe_timeout)
-        if err is not None:
-            platform_note = f"default backend unusable ({err}); cpu"
-            os.environ["JAX_PLATFORMS"] = "cpu"
-            import jax
+        return _run_probed_mode(args, run_jaxenv_bench,
+                                "jaxenv_decisions_per_sec", "decisions/s")
 
-            jax.config.update("jax_platforms", "cpu")
-        try:
-            payload = run_jaxenv_bench(args)
-            if platform_note:
-                payload["platform_note"] = platform_note
-            emit(payload)
-            return 0
-        except Exception:
-            tb = traceback.format_exc().strip().splitlines()
-            emit({"metric": "jaxenv_decisions_per_sec", "value": None,
-                  "unit": "decisions/s", "vs_baseline": None,
-                  "error": " | ".join(tb[-3:])})
-            return 1
+    if args.mode == "serve":
+        # same backend policy as jaxenv; the serve stack itself
+        # additionally degrades to the heuristic fallback if the device
+        # dies mid-run
+        return _run_probed_mode(args, run_serve_bench,
+                                "serve_decisions_per_sec", "decisions/s")
 
     if args.mode == "sim":
         # no device in the loop: never touch the (possibly hanging) TPU

@@ -30,6 +30,11 @@ class BaselineActor:
     def compute_action(self, obs, job_to_place=None, **kwargs) -> int:
         raise NotImplementedError
 
+    def reset(self) -> None:
+        """Episode boundary: stateful actors clear cross-decision state
+        here. EvalLoop calls this after every ``env.reset`` (train/
+        loops.py) so stale state can never leak across episodes."""
+
 
 class RandomActor(BaselineActor):
     name = "random"
@@ -260,6 +265,12 @@ class AdaptiveDegreePacking(BaselineActor):
         self.heavy_degree = heavy_degree
         self.heavy_threshold = heavy_threshold
         self.light_threshold = light_threshold
+        self.reset()
+
+    def reset(self) -> None:
+        # state for the legacy per-decision fallback estimate only (used
+        # when the cluster carries no arrival-demand counter); the primary
+        # path is stateless across decisions
         self._seq_sum = 0.0
         self._last_time = -1.0
         self._last_arrived = 0
@@ -268,18 +279,25 @@ class AdaptiveDegreePacking(BaselineActor):
         cluster = env.cluster
         now = float(cluster.stopwatch.time())
         arrived = int(cluster.num_jobs_arrived)
-        # fresh episode: time rewinds OR the arrival counter restarted
-        # (time alone can fail to rewind when a truncated episode ends
-        # earlier than the next one's first decision)
-        if now < self._last_time or arrived < self._last_arrived:
-            self._seq_sum = 0.0
-        self._last_time = now
-        self._last_arrived = arrived
-        self._seq_sum += float(job_to_place.seq_completion_time)
+        seq_sum = getattr(cluster, "sum_arrived_seq_completion_time", None)
+        if seq_sum is None:
+            # duck-typed cluster without the arrival counter: fall back to
+            # accumulating per decision. This undercounts demand in
+            # overload (queue-capacity-blocked arrivals never reach a
+            # decision step — ADVICE r5 item 2) and needs heuristic
+            # episode-reset detection; the cluster-counter path above has
+            # neither problem (the counter is reset with the cluster and
+            # counts every arrival, blocked or not).
+            if now < self._last_time or arrived < self._last_arrived:
+                self._seq_sum = 0.0
+            self._last_time = now
+            self._last_arrived = arrived
+            self._seq_sum += float(job_to_place.seq_completion_time)
+            seq_sum = self._seq_sum
         n = cluster.topology.num_workers
         if now <= 0.0 or arrived < 3:
             return float("nan")  # not enough signal yet
-        return self._seq_sum / now / n
+        return seq_sum / now / n
 
     def _static_target(self, target: int, group: int, max_action: int,
                        ramp_shape) -> int:

@@ -41,6 +41,17 @@ EDGE_FEATURE_DIM = 2
 GRAPH_FEATURE_DIM = 17
 
 
+def graph_feature_width(n_actions: int,
+                        include_candidate_prices: bool = False) -> int:
+    """The encoded ``graph_features`` vector width: base graph features +
+    the action mask + candidate prices when enabled. Single owner of the
+    formula — the observation space below and serving's
+    ``build_model_from_config`` (serve/server.py) both derive from it, so
+    a layout change here cannot silently desynchronise them."""
+    return GRAPH_FEATURE_DIM + n_actions * (
+        2 if include_candidate_prices else 1)
+
+
 @lru_cache(maxsize=None)
 def _block_shape_exists(action: int, ramp_shape: tuple) -> bool:
     """Static per-(action, topology) half of the validity test, memoised:
@@ -110,8 +121,8 @@ class RampJobPartitioningObservation:
                 0.0, 1.0, (max_e, EDGE_FEATURE_DIM), np.float32),
             "graph_features": spaces.Box(
                 0.0, 1.0,
-                (GRAPH_FEATURE_DIM + n_actions
-                 + (n_actions if self.include_candidate_prices else 0),),
+                (graph_feature_width(n_actions,
+                                     self.include_candidate_prices),),
                 np.float32),
             "edges_src": spaces.Box(0, max_n - 1, (max_e,), np.int32),
             "edges_dst": spaces.Box(0, max_n - 1, (max_e,), np.int32),
@@ -265,4 +276,33 @@ def _pad2(x: np.ndarray, n: int) -> np.ndarray:
 def _pad1(x: np.ndarray, n: int) -> np.ndarray:
     out = np.zeros((n,), dtype=x.dtype)
     out[:len(x)] = x
+    return out
+
+
+def pad_obs_to(obs: Dict[str, np.ndarray], max_nodes: int,
+               max_edges: int) -> Dict[str, np.ndarray]:
+    """Re-pad an encoded observation to a different (max_nodes, max_edges)
+    pad target, keeping exactly the true rows (``node_split``/``edge_split``)
+    and zero-filling the rest — the same masked-pad policy ``encode`` uses,
+    so the repad changes which rows are dead padding but never a real row.
+    The serving bucketer (serve/bucketing.py) uses this to snap incoming
+    observations, whatever bound the client padded to, onto its fixed
+    bucket shapes."""
+    n = int(np.asarray(obs["node_split"]).reshape(-1)[0])
+    m = int(np.asarray(obs["edge_split"]).reshape(-1)[0])
+    if n > max_nodes:
+        raise ValueError(f"obs has {n} ops but pad target "
+                         f"max_nodes={max_nodes}")
+    if m > max_edges:
+        raise ValueError(f"obs has {m} deps but pad target "
+                         f"max_edges={max_edges}")
+    out = dict(obs)
+    out["node_features"] = _pad2(
+        np.asarray(obs["node_features"], dtype=np.float32)[:n], max_nodes)
+    out["edge_features"] = _pad2(
+        np.asarray(obs["edge_features"], dtype=np.float32)[:m], max_edges)
+    for key in ("edges_src", "edges_dst"):
+        out[key] = _pad1(np.asarray(obs[key], dtype=np.int32)[:m], max_edges)
+    out["node_split"] = np.array([n], dtype=np.int32)
+    out["edge_split"] = np.array([m], dtype=np.int32)
     return out

@@ -1,0 +1,39 @@
+"""Online policy serving: bucketed padding + deadline microbatching +
+one fixed-shape jitted forward per bucket + heuristic degraded mode.
+
+See docs/serving.md for the design and its invariants; the entry points:
+
+* :class:`PolicyServer` — in-process request/response server;
+* :class:`ObsBucketer` / :func:`default_buckets` — (max_nodes, max_edges)
+  bucket ladder;
+* :class:`MicrobatchEngine` — flush-on-fill-or-deadline queueing;
+* :func:`load_checkpoint_params` — checkpoint -> policy variables without
+  a training loop;
+* ``scripts/serve_policy.py`` — stdin/JSON front end;
+* ``bench.py --mode serve`` — offered-load throughput/latency measurement.
+"""
+from ddls_tpu.serve.bucketing import (BucketOverflowError, BucketSpec,
+                                      ObsBucketer, default_buckets)
+from ddls_tpu.serve.microbatch import MicrobatchEngine, PendingRequest
+from ddls_tpu.serve.server import (DEFAULT_FALLBACK_DEGREE, BucketForward,
+                                   PolicyServer, ServeResponse, ServeStats,
+                                   build_model_from_config,
+                                   checkpoint_graph_feature_dim,
+                                   load_checkpoint_params)
+
+__all__ = [
+    "BucketForward",
+    "BucketOverflowError",
+    "BucketSpec",
+    "DEFAULT_FALLBACK_DEGREE",
+    "MicrobatchEngine",
+    "ObsBucketer",
+    "PendingRequest",
+    "PolicyServer",
+    "ServeResponse",
+    "ServeStats",
+    "build_model_from_config",
+    "checkpoint_graph_feature_dim",
+    "default_buckets",
+    "load_checkpoint_params",
+]

@@ -1,0 +1,85 @@
+"""Observation bucketing for online serving.
+
+The jitted forward compiles once per input shape, and the axon-tunnelled
+TPU pays ~116 ms per dispatch (CLAUDE.md), so the server cannot afford one
+compile per distinct graph size — nor one giant pad bound that drags ~20x
+dead masked rows through every forward (docs/perf_round2.md). The middle
+ground is a small fixed ladder of (max_nodes, max_edges) **buckets**: each
+incoming observation is re-padded (``envs.obs.pad_obs_to`` — the masked-pad
+policy, real rows untouched) into the smallest bucket that fits, so the
+whole request population compiles exactly ``len(buckets)`` programs.
+
+Bucket choice is deterministic in the request's true (n_ops, n_deps), so a
+given request always runs the same program — reproducible decisions.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ddls_tpu.envs.obs import pad_obs_to
+
+BucketSpec = Tuple[int, int]  # (max_nodes, max_edges)
+
+
+def default_buckets(max_nodes: int, max_edges: Optional[int] = None,
+                    n_buckets: int = 3) -> List[BucketSpec]:
+    """A halving ladder ending at the dataset bound: e.g. 32 nodes ->
+    [(8, e/4), (16, e/2), (32, e)]. ``max_edges`` defaults to the
+    fully-connected bound (the reference's own pad policy; pass the
+    dataset's true dep bound for tight buckets, as bench.py does)."""
+    if max_nodes < 1:
+        raise ValueError(f"max_nodes must be >= 1, got {max_nodes}")
+    if max_edges is None:
+        max_edges = (max_nodes * (max_nodes - 1)) // 2
+    buckets: List[BucketSpec] = []
+    n, e = int(max_nodes), int(max_edges)
+    for _ in range(max(1, n_buckets)):
+        buckets.append((n, max(e, 1)))
+        if n <= 2:
+            break
+        n = (n + 1) // 2
+        e = (e + 1) // 2
+    return sorted(set(buckets))
+
+
+class ObsBucketer:
+    """Maps encoded observations onto a fixed bucket ladder.
+
+    ``buckets`` is a sequence of (max_nodes, max_edges) pairs; selection is
+    smallest-first by (nodes, edges) with both dimensions required to fit.
+    Requests larger than every bucket raise ``BucketOverflowError`` — the
+    server answers those from the heuristic fallback rather than compiling
+    an unbounded program on demand.
+    """
+
+    def __init__(self, buckets: Sequence[BucketSpec]):
+        if not buckets:
+            raise ValueError("need at least one bucket")
+        self.buckets: List[BucketSpec] = sorted(
+            (int(n), int(e)) for n, e in buckets)
+        for n, e in self.buckets:
+            if n < 1 or e < 1:
+                raise ValueError(f"bucket ({n}, {e}) must be positive")
+
+    def bucket_index(self, n_nodes: int, n_edges: int) -> int:
+        for i, (bn, be) in enumerate(self.buckets):
+            if n_nodes <= bn and n_edges <= be:
+                return i
+        raise BucketOverflowError(
+            f"graph with {n_nodes} ops / {n_edges} deps exceeds every "
+            f"bucket {self.buckets}")
+
+    def bucket_obs(self, obs: Dict[str, np.ndarray]
+                   ) -> Tuple[int, Dict[str, np.ndarray]]:
+        """Pick the smallest fitting bucket and re-pad the obs into it."""
+        n = int(np.asarray(obs["node_split"]).reshape(-1)[0])
+        m = int(np.asarray(obs["edge_split"]).reshape(-1)[0])
+        idx = self.bucket_index(n, m)
+        bn, be = self.buckets[idx]
+        return idx, pad_obs_to(obs, bn, be)
+
+
+class BucketOverflowError(ValueError):
+    """Raised when a request graph fits no configured bucket."""

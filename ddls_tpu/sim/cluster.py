@@ -122,6 +122,14 @@ class RampClusterEnvironment:
         self.job_queue = JobQueue(queue_capacity=job_queue_capacity)
 
         self.num_jobs_arrived = 0
+        # worker-seconds of demand that have ARRIVED (blocked arrivals
+        # included): the numerator of the online per-server load estimate
+        # rho = sum / elapsed / n_servers that AdaptiveDegreePacking reads
+        # (envs/baselines.py). Accumulated at arrival, not at decision
+        # time, so queue-capacity-blocked jobs still count — a
+        # per-decision estimate is biased low exactly in overload
+        # (ADVICE r5 item 2)
+        self.sum_arrived_seq_completion_time = 0.0
         self.load_rates: List[float] = []
         self.mounted_workers: Set[str] = set()
         self.mounted_channels: Set[str] = set()
@@ -238,6 +246,8 @@ class RampClusterEnvironment:
         self.job_idx_to_job_id[job_idx] = job.job_id
         self.job_id_to_job_idx[job.job_id] = job_idx
         self.num_jobs_arrived += 1
+        self.sum_arrived_seq_completion_time += float(
+            job.seq_completion_time)
         self.last_job_arrived_job_idx = job_idx
         self.episode_stats["num_jobs_arrived"] += 1
         return job

@@ -387,17 +387,29 @@ class RLEpochLoop:
         mesh = None
         local = jax.local_devices()
         if len(local) > 1:
-            if self.num_envs % len(local) == 0:
-                if jax.process_count() == 1:
-                    mesh = self.mesh
-                else:
-                    from ddls_tpu.parallel.mesh import make_mesh
-                    mesh = make_mesh(len(local), devices=local)
+            # the candidate mesh is what the collector would actually
+            # shard over: the configured training mesh in single-process
+            # mode (possibly FEWER devices than the host exposes), a
+            # per-process local mesh otherwise
+            if jax.process_count() == 1:
+                candidate = self.mesh
+            else:
+                from ddls_tpu.parallel.mesh import make_mesh
+                candidate = make_mesh(len(local), devices=local)
+            # gate on the value DevicePPOCollector validates (ppo_device
+            # .py: num_envs % mesh.shape['dp']), not the local device
+            # count — e.g. n_devices=3 on an 8-device host with
+            # num_envs=8 divides the host but not the mesh, and must
+            # fall back to single-device collection instead of raising
+            # (ADVICE r5 item 1)
+            dp = int(candidate.shape["dp"])
+            if self.num_envs % dp == 0:
+                mesh = candidate
             else:
                 import warnings
                 warnings.warn(
                     f"device_collector: num_envs={self.num_envs} not "
-                    f"divisible by {len(local)} local devices; lanes "
+                    f"divisible by the mesh dp axis ({dp}); lanes "
                     "will collect on ONE device (set num_envs to a "
                     "multiple for sharded collection)")
         return DevicePPOCollector(et, ot, self.model, stacked,
@@ -1015,6 +1027,11 @@ class EvalLoop:
     def run(self, seed: Optional[int] = None,
             max_steps: Optional[int] = None) -> Dict[str, Any]:
         obs = self.env.reset(seed=seed)
+        # episode boundary for stateful actors (e.g. AdaptiveDegreePacking's
+        # legacy load estimate): explicit reset beats heuristic detection
+        reset = getattr(self.actor, "reset", None)
+        if callable(reset):
+            reset()
         done, steps, total_reward = False, 0, 0.0
         start = time.time()
         while not done and (max_steps is None or steps < max_steps):

@@ -1,0 +1,324 @@
+"""Online policy-serving front end: JSON requests on stdin, decisions on
+stdout.
+
+Each input line is one request::
+
+    {"id": "job-17", "obs": {"node_features": [[...]], "edge_features":
+     [[...]], "graph_features": [...], "edges_src": [...], "edges_dst":
+     [...], "node_split": [n], "edge_split": [m], "action_set": [...],
+     "action_mask": [...]}}
+
+``obs`` is the encoded observation dict ``envs/obs.py`` produces (any pad
+bound — the server re-pads onto its bucket ladder). Each answered request
+emits one line::
+
+    {"id": "job-17", "action": 8, "source": "policy", "reason": "batched",
+     "bucket": 1, "latency_ms": 3.2}
+
+Requests microbatch through ``ddls_tpu.serve.PolicyServer`` (flush on fill
+or deadline; heuristic ``FixedDegreePacking`` fallback when the queue
+saturates, a graph fits no bucket, or the device backend fails). A summary
+JSON line with the serving counters lands on stderr at EOF.
+
+``--selftest`` runs the whole pipeline end-to-end on a synthetic dataset
+(CPU-pinned, no TPU probe): real env observations through the bucketed
+batched forward, plus a forced-saturation pass through the fallback, then
+prints one ``{"selftest": "ok", ...}`` line and exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+_OBS_INT_KEYS = ("edges_src", "edges_dst", "node_split", "edge_split",
+                 "action_set", "action_mask")
+
+
+class LineAssembler:
+    """Splits raw fd chunks into complete lines. The serving loop selects
+    on the stdin fd, and select() reports readable once per CHUNK, not
+    once per line — so every complete line in a chunk must be handled
+    before returning to select. A buffered ``sys.stdin.readline()`` there
+    would return line 1, drain the fd into Python's buffer, and leave
+    lines 2..N stranded while select blocks on the now-unreadable fd: a
+    long-lived client that writes a burst and waits for answers deadlocks
+    (EOF-terminated pipes mask this — a closed pipe keeps the fd
+    readable)."""
+
+    def __init__(self):
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> list:
+        self._buf += chunk
+        *lines, self._buf = self._buf.split(b"\n")
+        return [ln.decode("utf-8", "replace") for ln in lines]
+
+    def flush(self) -> list:
+        """The final unterminated line at EOF, if any."""
+        buf, self._buf = self._buf, b""
+        return [buf.decode("utf-8", "replace")] if buf.strip() else []
+
+
+def obs_from_json(obj: dict) -> dict:
+    obs = {}
+    for key, val in obj.items():
+        dtype = np.int32 if key in _OBS_INT_KEYS else np.float32
+        obs[key] = np.asarray(val, dtype=dtype)
+    for key in ("node_split", "edge_split"):
+        obs[key] = np.atleast_1d(obs[key])
+    return obs
+
+
+def build_model_from_config(config_path, config_name, overrides):
+    """(model, n_actions, graph_feature_dim) — checkpoint-faithful model
+    construction lives with the serve subsystem (bench.py
+    --serve-checkpoint shares it)."""
+    from ddls_tpu.serve import build_model_from_config as _build
+
+    return _build(config_path, config_name, overrides)
+
+
+def make_server(args, model, params, graph_feature_dim=None):
+    from ddls_tpu.envs.baselines import FixedDegreePacking
+    from ddls_tpu.serve import PolicyServer
+
+    buckets = None
+    if args.buckets:
+        buckets = [tuple(int(x) for x in b.split("x"))
+                   for b in args.buckets.split(",")]
+    return PolicyServer(
+        model, params, buckets=buckets,
+        max_nodes=args.max_nodes, max_batch=args.max_batch,
+        deadline_s=args.deadline_ms / 1e3, max_queue=args.max_queue,
+        graph_feature_dim=graph_feature_dim,
+        fallback=FixedDegreePacking(degree=args.degree))
+
+
+def template_obs(max_nodes: int, max_edges: int, n_actions: int,
+                 graph_feature_dim: int) -> dict:
+    """A zero observation at a bucket shape — enough to init params.
+    Feature widths come from the encode contract (envs/obs.py), not
+    hardcoded: a width drift would init params the real requests can't
+    run through."""
+    from ddls_tpu.envs.obs import EDGE_FEATURE_DIM, NODE_FEATURE_DIM
+
+    return {
+        "action_set": np.arange(n_actions, dtype=np.int32),
+        "action_mask": np.ones(n_actions, np.int32),
+        "node_features": np.zeros((max_nodes, NODE_FEATURE_DIM),
+                                  np.float32),
+        "edge_features": np.zeros((max_edges, EDGE_FEATURE_DIM),
+                                  np.float32),
+        "graph_features": np.zeros(graph_feature_dim, np.float32),
+        "edges_src": np.zeros(max_edges, np.int32),
+        "edges_dst": np.zeros(max_edges, np.int32),
+        "node_split": np.array([1], np.int32),
+        "edge_split": np.array([0], np.int32),
+    }
+
+
+def run_selftest(args) -> int:
+    """End-to-end smoke on CPU: real env obs -> bucketed batched serving,
+    then a forced-saturation fallback pass. One JSON line, rc 0 on ok."""
+    import jax
+
+    import bench
+    from ddls_tpu.envs.baselines import FixedDegreePacking
+    from ddls_tpu.models.policy import GNNPolicy
+    from ddls_tpu.serve import PolicyServer, default_buckets
+
+    dataset_dir = bench._make_dataset()
+    pool = bench._serve_obs_pool(dataset_dir, args.selftest_requests)
+    n_actions = int(np.asarray(pool[0]["action_mask"]).shape[0])
+    bounds = bench._dataset_pad_bounds(dataset_dir)
+    buckets = default_buckets(bounds["max_nodes"], bounds["max_edges"])
+    model = GNNPolicy(n_actions=n_actions)
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.tree_util.tree_map(np.asarray, pool[0]))
+
+    server = PolicyServer(model, params, buckets=buckets,
+                          max_batch=args.max_batch,
+                          deadline_s=args.deadline_ms / 1e3,
+                          fallback=FixedDegreePacking(degree=args.degree))
+    ids = [server.submit(o) for o in pool]
+    responses = server.drain()
+    ok = (sorted(r.request_id for r in responses) == sorted(ids)
+          and all(np.asarray(pool[r.request_id]["action_mask"])[r.action]
+                  for r in responses))
+
+    # saturation pass: a 2-deep queue answers the overflow from the
+    # heuristic without dropping anything
+    sat = PolicyServer(model, params, buckets=buckets,
+                       max_batch=args.max_batch, deadline_s=10.0,
+                       max_queue=2,
+                       fallback=FixedDegreePacking(degree=args.degree))
+    rule = FixedDegreePacking(degree=args.degree)
+    for o in pool:
+        sat.submit(o)
+    sat_responses = sat.poll() + sat.drain()
+    fb = [r for r in sat_responses if r.source == "fallback"]
+    ok = (ok and len(sat_responses) == len(pool) and len(fb) > 0
+          and all(r.action == rule.compute_action(pool[r.request_id])
+                  for r in fb))
+
+    print(json.dumps({"selftest": "ok" if ok else "FAILED",
+                      "n_requests": len(pool),
+                      "n_fallback_saturated": len(fb),
+                      **{f"serve_{k}": v
+                         for k, v in server.stats.summary().items()
+                         if not isinstance(v, dict)}}), flush=True)
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Serve partition-degree decisions over stdin/stdout")
+    parser.add_argument("--checkpoint", default=None,
+                        help="orbax checkpoint dir (omit for random-init "
+                             "params — selftest/smoke only)")
+    parser.add_argument("--config-path",
+                        default=os.path.join(os.path.dirname(__file__),
+                                             "ramp_job_partitioning_configs"))
+    parser.add_argument("--config-name", default="rllib_config")
+    parser.add_argument("--override", action="append", default=[],
+                        help="config override, e.g. env_config=env_load32")
+    parser.add_argument("--buckets", default=None,
+                        help="explicit ladder, e.g. '16x32,32x96'")
+    parser.add_argument("--max-nodes", type=int, default=32,
+                        help="top bucket bound when --buckets is omitted")
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--deadline-ms", type=float, default=10.0)
+    parser.add_argument("--max-queue", type=int, default=64)
+    parser.add_argument("--degree", type=int, default=8,
+                        help="FixedDegreePacking fallback degree (8 = the "
+                             "canonical 32-server extraction)")
+    parser.add_argument("--selftest", action="store_true",
+                        help="CPU end-to-end smoke; no stdin")
+    parser.add_argument("--selftest-requests", type=int, default=24)
+    parser.add_argument("--probe-timeout", type=float, default=240.0,
+                        help="bounded backend-init probe before serving "
+                             "(production path only; falls back to cpu)")
+    args = parser.parse_args(argv)
+
+    if args.selftest:
+        # tier-1 contract: the selftest never probes an accelerator
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        return run_selftest(args)
+
+    # production path: bounded backend probe BEFORE the first in-process
+    # jax import — a wedged axon tunnel must cost one timeout at startup,
+    # not hang the first batch (the serve stack additionally degrades to
+    # the heuristic if the device dies mid-run)
+    import bench
+
+    err = bench.probe_backend(args.probe_timeout)
+    if err is not None:
+        print(f"warning: default backend unusable ({err}); serving on cpu",
+              file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    model, n_actions, graph_dim = build_model_from_config(
+        args.config_path, args.config_name, args.override)
+    if args.checkpoint:
+        from ddls_tpu.serve import (checkpoint_graph_feature_dim,
+                                    load_checkpoint_params)
+
+        params = load_checkpoint_params(args.checkpoint)
+        # reject a checkpoint/config mismatch at startup with its actual
+        # cause: restore is target-free, so mis-paired params would load
+        # fine and then fail the first forward — which the server would
+        # misread as a dead backend and latch degraded mode
+        ckpt_dim = checkpoint_graph_feature_dim(params)
+        if ckpt_dim is not None and ckpt_dim != graph_dim:
+            print(f"error: checkpoint {args.checkpoint} was trained at "
+                  f"graph width {ckpt_dim} but the config builds "
+                  f"{graph_dim}; pass the checkpoint's training config "
+                  f"(--config-name/--override)", file=sys.stderr)
+            return 2
+    else:
+        import jax
+
+        print("warning: no --checkpoint; serving RANDOM-INIT params",
+              file=sys.stderr)
+        params = model.init(
+            jax.random.PRNGKey(0),
+            {k: np.asarray(v) for k, v in template_obs(
+                args.max_nodes, args.max_nodes * 2, n_actions,
+                graph_dim).items()})
+
+    server = make_server(args, model, params, graph_feature_dim=graph_dim)
+    rid_to_client: dict = {}
+
+    def emit_responses(responses) -> None:
+        for r in responses:
+            print(json.dumps({
+                "id": rid_to_client.pop(r.request_id, r.request_id),
+                "action": r.action, "source": r.source,
+                "reason": r.reason, "bucket": r.bucket_idx,
+                "latency_ms": round(r.latency_s * 1e3, 3)}), flush=True)
+
+    def handle_line(line: str) -> None:
+        if not line.strip():
+            return
+        # one malformed line errors to ITS client and never kills
+        # the serving loop (or the batches already queued)
+        client_id = None
+        try:
+            obj = json.loads(line)
+            if isinstance(obj, dict):
+                client_id = obj.get("id")
+            rid = server.submit(obs_from_json(obj["obs"]))
+            rid_to_client[rid] = (client_id if client_id is not None
+                                  else rid)
+        except Exception as exc:
+            print(json.dumps({
+                "id": client_id,
+                "error": f"{type(exc).__name__}: {exc}"}),
+                flush=True)
+
+    # select-with-timeout pump: deadline flushes must fire while BLOCKED
+    # on input, or an interactive client (one request, waits for the
+    # answer before sending the next) deadlocks against its own partial
+    # batch until EOF. Reads go through os.read on the raw fd +
+    # LineAssembler, NOT buffered readline — see LineAssembler.
+    import select
+    import time
+
+    fd = sys.stdin.fileno()
+    lines_in = LineAssembler()
+    stdin_open = True
+    while stdin_open:
+        deadline = server.next_deadline()
+        timeout = (None if deadline is None
+                   else max(0.0, deadline - time.perf_counter()))
+        ready, _, _ = select.select([fd], [], [], timeout)
+        if ready:
+            chunk = os.read(fd, 1 << 16)
+            if not chunk:
+                stdin_open = False
+                for line in lines_in.flush():
+                    handle_line(line)
+            else:
+                for line in lines_in.feed(chunk):
+                    handle_line(line)
+        emit_responses(server.poll())
+    emit_responses(server.drain())
+    print(json.dumps({"serve_stats": server.stats.summary()}),
+          file=sys.stderr, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
