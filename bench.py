@@ -35,6 +35,8 @@ import traceback
 
 import numpy as np
 
+from ddls_tpu import telemetry
+
 REFERENCE_ENV_STEPS_PER_SEC = 240.0  # documented estimate, see module docstring
 BASELINE_SOURCE = "estimate"  # reference publishes no numbers (BASELINE.json)
 
@@ -125,6 +127,13 @@ def compiled_cost_analysis(jitted, *args, n_dev: int,
 def emit(payload: dict) -> None:
     """The driver parses exactly one JSON line from stdout."""
     print(json.dumps(payload), flush=True)
+    # mirror the final registry state to the JSONL sink (no-op without
+    # one) so --telemetry-jsonl files are self-contained even on the
+    # error/timeout emit paths; serve mode's private server registry
+    # rides along under the same "serve" key the JSON line uses
+    tele = payload.get("telemetry") or {}
+    telemetry.dump_snapshot(
+        extra={"serve": tele["serve"]} if "serve" in tele else None)
 
 
 def probe_backend(timeout: float, force_cpu: bool = False) -> str | None:
@@ -138,16 +147,37 @@ def probe_backend(timeout: float, force_cpu: bool = False) -> str | None:
     """
     pin = ('jax.config.update("jax_platforms", "cpu"); ' if force_cpu else "")
     code = f"import jax; {pin}d = jax.devices(); print(len(d), d[0].platform)"
+    # probe outcomes leave a telemetry trail (ISSUE 3): a wedge must be
+    # diagnosable from the JSON line / sink, not a silent cpu fallback
+    telemetry.record_event("tpu_probe", phase="attempt",
+                           timeout_s=float(timeout),
+                           force_cpu=bool(force_cpu))
+    probe_span = telemetry.span("tpu.probe")
     try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True, timeout=timeout,
-                             env=os.environ.copy())
+        with probe_span:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, text=True,
+                                 timeout=timeout, env=os.environ.copy())
     except subprocess.TimeoutExpired:
+        # a timed-out init is the wedged-tunnel signature (CLAUDE.md,
+        # docs/perf_round4.md: the axon endpoint can hang for hours)
+        telemetry.record_event(
+            "tpu_probe", phase="timeout", wedge_suspected=True,
+            timeout_s=float(timeout),
+            elapsed_ms=round(probe_span.duration_s * 1e3, 1))
         return f"jax backend init timed out after {timeout:.0f}s"
+    rtt_ms = round(probe_span.duration_s * 1e3, 1)
     if out.returncode == 0:
+        telemetry.record_event("tpu_probe", phase="success",
+                               round_trip_ms=rtt_ms,
+                               platform=(out.stdout.split()[-1]
+                                         if out.stdout.split() else None))
         return None
     tail = (out.stderr or "").strip().splitlines()
-    return tail[-1] if tail else f"jax backend probe exited rc={out.returncode}"
+    err = tail[-1] if tail else f"jax backend probe exited rc={out.returncode}"
+    telemetry.record_event("tpu_probe", phase="error",
+                           round_trip_ms=rtt_ms, error=err)
+    return err
 
 
 def _dataset_pad_bounds(dataset_dir: str) -> dict:
@@ -283,17 +313,18 @@ def run_sim_bench(args) -> dict:
             acts[i] = rng.choice(valid)
         return acts
 
+    telemetry.enable()  # idempotent; main() resets + enables per run
     warmup = max(1, args.rollout_length // 2)
-    for _ in range(warmup):
-        vec.step(random_actions())
-    t0 = time.perf_counter()
+    with telemetry.span("bench.warmup"):
+        for _ in range(warmup):
+            vec.step(random_actions())
     n = 0
-    while time.perf_counter() - t0 < args.sim_seconds:
-        vec.step(random_actions())
-        n += vec.num_envs
-    dt = time.perf_counter() - t0
+    with telemetry.span("bench.run") as run_span:
+        while run_span.elapsed() < args.sim_seconds:
+            vec.step(random_actions())
+            n += vec.num_envs
     vec.close()
-    value = n / dt
+    value = n / run_span.duration_s
     return {
         "metric": "sim_env_steps_per_sec",
         "value": round(value, 2),
@@ -305,6 +336,9 @@ def run_sim_bench(args) -> dict:
         "baseline_source": BASELINE_SOURCE,
         "num_envs": args.num_envs,
         "cores": _available_cores(),
+        # warmup/run wall split + the simulator's own cache counters
+        # (lookahead/partition memo hit rates) from the same snapshot
+        "telemetry": telemetry.snapshot(),
     }
 
 
@@ -348,35 +382,37 @@ def run_jaxenv_bench(args) -> dict:
                 for k, v in build_job_bank(et, recs).items()}
 
     actions = jnp.asarray(rng.choice(degrees, size=D), jnp.int32)
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(episode_fn(mk_bank(0), actions))
-    compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    out = jax.block_until_ready(episode_fn(mk_bank(1), actions))
-    single_s = time.perf_counter() - t0
+    telemetry.enable()
+    # compile vs run split as uniform spans (ISSUE 3): same names across
+    # every mode, so a sink/report compares them without bespoke keys
+    with telemetry.span("bench.compile") as compile_span:
+        out = jax.block_until_ready(episode_fn(mk_bank(0), actions))
+    with telemetry.span("bench.run") as run_span:
+        out = jax.block_until_ready(episode_fn(mk_bank(1), actions))
     n_dec = int(np.asarray(out["trace"][5]).sum())
 
     vfn = jax.jit(jax.vmap(episode_fn, in_axes=(0, 0)))
     banks = [mk_bank(s) for s in range(8)]
     bb = {k: jnp.stack([b[k] for b in banks]) for k in banks[0]}
     aa = jnp.broadcast_to(actions, (8, D))
-    jax.block_until_ready(vfn(bb, aa))
-    t0 = time.perf_counter()
-    vout = jax.block_until_ready(vfn(bb, aa))
-    vmap_s = time.perf_counter() - t0
+    with telemetry.span("bench.vmap8_compile"):
+        jax.block_until_ready(vfn(bb, aa))
+    with telemetry.span("bench.vmap8") as vmap_span:
+        vout = jax.block_until_ready(vfn(bb, aa))
     vdec = int(np.asarray(vout["trace"][5]).sum())
 
     return {
         "metric": "jaxenv_decisions_per_sec",
-        "value": round(n_dec / single_s, 2),
+        "value": round(n_dec / run_span.duration_s, 2),
         "unit": "decisions/s",
         "vs_baseline": None,
         "baseline_source": BASELINE_SOURCE,
         "platform": jax.devices()[0].platform,
-        "compile_seconds": round(compile_s, 1),
-        "vmap8_decisions_per_sec": round(vdec / vmap_s, 2),
+        "compile_seconds": round(compile_span.duration_s, 1),
+        "vmap8_decisions_per_sec": round(vdec / vmap_span.duration_s, 2),
         "max_degree": args.jaxenv_max_degree,
         "pads": {"ops": et.pads.n_ops, "deps": et.pads.n_deps},
+        "telemetry": telemetry.snapshot(),
     }
 
 
@@ -482,37 +518,40 @@ def run_serve_bench(args) -> dict:
                 break
     server.stats = type(server.stats)()  # reset counters post-warmup
 
+    telemetry.enable()
     rng = np.random.RandomState(1)
     n = args.serve_requests
     arrivals = np.cumsum(rng.exponential(1.0 / args.serve_rps, size=n))
     responses = []
-    start = time.perf_counter()
-    i = 0
-    while len(responses) < n:
-        now = time.perf_counter()
-        while i < n and now - start >= arrivals[i]:
-            # charge latency (and the deadline clock) from the ARRIVAL
-            # instant, not the submit-loop instant: arrivals that land
-            # while the loop is blocked in a device forward must still pay
-            # that wait, or p50/p99 are biased low exactly in overload
-            # (classic coordinated omission)
-            server.submit(pool[i % len(pool)], now=start + arrivals[i])
-            i += 1
+    with telemetry.span("bench.run") as run_span:
+        start = time.perf_counter()
+        i = 0
+        while len(responses) < n:
             now = time.perf_counter()
-        responses.extend(server.poll())
-        if len(responses) >= n:
-            break
-        # sleep to the next event (arrival or batch deadline), never long
-        next_events = [start + arrivals[i]] if i < n else []
-        deadline = server.next_deadline()
-        if deadline is not None:
-            next_events.append(deadline)
-        if next_events:
-            time.sleep(min(max(min(next_events) - time.perf_counter(), 0.0),
-                           0.005))
-        elif i >= n:
-            responses.extend(server.drain())
-    elapsed = time.perf_counter() - start
+            while i < n and now - start >= arrivals[i]:
+                # charge latency (and the deadline clock) from the ARRIVAL
+                # instant, not the submit-loop instant: arrivals that land
+                # while the loop is blocked in a device forward must still
+                # pay that wait, or p50/p99 are biased low exactly in
+                # overload (classic coordinated omission)
+                server.submit(pool[i % len(pool)], now=start + arrivals[i])
+                i += 1
+                now = time.perf_counter()
+            responses.extend(server.poll())
+            if len(responses) >= n:
+                break
+            # sleep to the next event (arrival or batch deadline), never
+            # long
+            next_events = [start + arrivals[i]] if i < n else []
+            deadline = server.next_deadline()
+            if deadline is not None:
+                next_events.append(deadline)
+            if next_events:
+                time.sleep(min(max(min(next_events) - time.perf_counter(),
+                                   0.0), 0.005))
+            elif i >= n:
+                responses.extend(server.drain())
+    elapsed = run_span.duration_s
 
     s = server.stats.summary()
     return {
@@ -538,6 +577,11 @@ def run_serve_bench(args) -> dict:
         "buckets": [list(b) for b in buckets],
         "params_source": params_source,
         "cores": _available_cores(),
+        # global spans/probe counters + the server's private registry
+        # (serve.latency_s histogram etc. — same window the p50/p99
+        # fields above are computed from, so the two always agree)
+        "telemetry": {**telemetry.snapshot(),
+                      "serve": server.stats.registry.snapshot()},
     }
 
 
@@ -590,6 +634,7 @@ def run_bench(args, platform_note: str | None,
     state = learner.init_state(params)
     collector = RolloutCollector(vec, learner, args.rollout_length)
 
+    telemetry.enable()
     update_time = [0.0]
 
     def one_epoch(state, rng):
@@ -597,24 +642,27 @@ def run_bench(args, platform_note: str | None,
         # than re-uploading the whole tree every rollout step
         out = collector.collect(state.params, rng)
         straj, slv = learner.shard_traj(out["traj"], out["last_values"])
-        tu = time.perf_counter()
-        state, metrics = learner.train_step(state, straj, slv, rng)
-        jax.block_until_ready(metrics["total_loss"])
-        update_time[0] += time.perf_counter() - tu
+        with telemetry.span("bench.update") as update_span:
+            state, metrics = learner.train_step(state, straj, slv, rng)
+            jax.block_until_ready(metrics["total_loss"])
+        update_time[0] += update_span.duration_s
         return state, out["env_steps"], (straj, slv)
 
     rng = jax.random.PRNGKey(1)
     update_args = None
     warmup_completed = 0
-    for i in range(args.warmup_epochs):
-        rng, sub = jax.random.split(rng)
-        state, _, update_args = one_epoch(state, sub)
-        warmup_completed += 1
-        # warmup must leave room for >=1 timed epoch + the JSON emit (the
-        # probe may already have burned its timeout against a wedged TPU);
-        # a short warmup only biases the smoke number slow, never kills it
-        if time.perf_counter() - process_start > 0.6 * args.budget_seconds:
-            break
+    with telemetry.span("bench.warmup"):
+        for i in range(args.warmup_epochs):
+            rng, sub = jax.random.split(rng)
+            state, _, update_args = one_epoch(state, sub)
+            warmup_completed += 1
+            # warmup must leave room for >=1 timed epoch + the JSON emit
+            # (the probe may already have burned its timeout against a
+            # wedged TPU); a short warmup only biases the smoke number
+            # slow, never kills it
+            if (time.perf_counter() - process_start
+                    > 0.6 * args.budget_seconds):
+                break
 
     # FLOPs of ONE compiled update step (cached compile: same shapes as the
     # warmed-up call). Grabbed before timing so it can't perturb the clock.
@@ -625,20 +673,21 @@ def run_bench(args, platform_note: str | None,
             learner._jit_train_step, state, straj, slv, rng)
 
     update_time[0] = 0.0
-    t0 = time.perf_counter()
     total_steps = 0
     epochs_run = 0
-    for i in range(args.timed_epochs):
-        rng, sub = jax.random.split(rng)
-        state, n, _ = one_epoch(state, sub)
-        total_steps += n
-        epochs_run += 1
-        # a measurement must always land inside the driver's budget; the
-        # clock is anchored at process start so probe/setup time counts.
-        # Stop early (with >=1 timed epoch recorded) rather than get killed
-        if time.perf_counter() - process_start > args.budget_seconds:
-            break
-    dt = time.perf_counter() - t0
+    with telemetry.span("bench.run") as run_span:
+        for i in range(args.timed_epochs):
+            rng, sub = jax.random.split(rng)
+            state, n, _ = one_epoch(state, sub)
+            total_steps += n
+            epochs_run += 1
+            # a measurement must always land inside the driver's budget;
+            # the clock is anchored at process start so probe/setup time
+            # counts. Stop early (with >=1 timed epoch recorded) rather
+            # than get killed
+            if time.perf_counter() - process_start > args.budget_seconds:
+                break
+    dt = run_span.duration_s
 
     vec.close()
     value = total_steps / dt
@@ -661,6 +710,10 @@ def run_bench(args, platform_note: str | None,
         "warmup_epochs_completed": warmup_completed,
         "warmup_epochs_target": args.warmup_epochs,
         "cores": _available_cores(),
+        # per-update spans (collect rides inside one_epoch's wall time;
+        # bench.update isolates the jitted sharded update) + sim cache
+        # counters + probe outcomes, one vocabulary across modes
+        "telemetry": telemetry.snapshot(),
     }
     if platform_note:
         payload["platform_note"] = platform_note
@@ -790,7 +843,35 @@ def main(argv=None) -> int:
     parser.add_argument("--budget-seconds", type=float, default=420.0,
                         help="stop timing epochs past this wall-clock "
                              "budget so a JSON line always lands")
+    parser.add_argument("--telemetry-jsonl", default=None,
+                        help="append span/event/snapshot records to this "
+                             "JSONL sink (see scripts/telemetry_report.py;"
+                             " env fallback: DDLS_TELEMETRY_JSONL)")
     args = parser.parse_args(argv)
+    # fresh telemetry window per invocation (tests drive main() several
+    # times in one process; each bench line must snapshot ITS run only),
+    # and the PREVIOUS global state — enabled flag, sink, AND the
+    # caller's accumulated metrics — is restored on the way out: an
+    # in-process caller must neither inherit an enabled registry / stale
+    # sink / bench's spans, nor lose its own metrics to bench's reset
+    # (the golden/parity suites pin the telemetry-disabled behaviour)
+    reg = telemetry.registry()
+    prev_enabled, prev_sink = reg.enabled, reg.sink
+    prev_metrics = reg.metrics_state()
+    telemetry.reset()
+    telemetry.enable(sink_path=(args.telemetry_jsonl
+                                or telemetry.env_sink_path()))
+    try:
+        return _dispatch_mode(args, process_start)
+    finally:
+        if reg.sink is not prev_sink and reg.sink is not None:
+            reg.sink.close()
+        reg.sink = prev_sink
+        reg.enabled = prev_enabled
+        reg.restore_metrics_state(prev_metrics)
+
+
+def _dispatch_mode(args, process_start: float) -> int:
     if args.num_envs is None:
         cores = _available_cores()
         if cores == 1:

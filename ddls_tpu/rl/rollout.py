@@ -21,6 +21,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from ddls_tpu import telemetry
+
 OBS_KEYS = ("node_features", "edge_features", "graph_features",
             "edges_src", "edges_dst", "node_split", "edge_split",
             "action_mask")
@@ -128,15 +130,24 @@ class VectorEnv:
 
 
 def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
-                         env_index: int, seed: int, seed_stride: int) -> None:
+                         env_index: int, seed: int, seed_stride: int,
+                         telemetry_enabled: bool = False) -> None:
     """Subprocess body: owns one env, steps it on command, auto-resets.
 
     ``env_builder`` is a picklable callable (class or factory) receiving
     ``**env_kwargs`` — the process-parallel replacement for RLlib's Ray
     rollout workers, each of which builds its own env from the env_config
     (SURVEY.md §3.1 process-boundary note).
+
+    ``telemetry_enabled`` mirrors the parent's telemetry switch into this
+    process (spawned workers start with the global registry disabled);
+    the worker's counters — the sim-layer cache hit/miss counts live
+    HERE, not in the parent — ride back on the "closed" ack and are
+    merged into the parent registry by ``ParallelVectorEnv.close``.
     """
     try:
+        if telemetry_enabled:
+            telemetry.enable()
         env = env_builder(**env_kwargs)
         episode_return, episode_length = 0.0, 0
         while True:
@@ -167,7 +178,10 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
                     episode_return, episode_length = 0.0, 0
                 conn.send(("step", (obs, float(reward), bool(done), record)))
             elif cmd == "close":
-                conn.send(("closed", None))
+                # counters only: cross-process histogram merge is lossy,
+                # and the sim layer records nothing but counters
+                counters = telemetry.snapshot().get("counters") or None
+                conn.send(("closed", counters))
                 return
     except KeyboardInterrupt:
         pass
@@ -199,7 +213,7 @@ class ParallelVectorEnv:
             proc = ctx.Process(
                 target=_parallel_env_worker,
                 args=(child, env_builder, env_kwargs, i, self.seeds[i],
-                      num_envs),
+                      num_envs, telemetry.enabled()),
                 daemon=True)
             proc.start()
             child.close()
@@ -264,6 +278,27 @@ class ParallelVectorEnv:
             try:
                 conn.send(("close", None))
             except (BrokenPipeError, OSError):
+                pass
+        # drain to each worker's "closed" ack (stale step replies may sit
+        # ahead of it when closing after a worker error) and merge the
+        # worker's telemetry counters into this process's registry. One
+        # SHARED 2 s deadline across all conns: a wedged worker must not
+        # serially cost 2 s per env on the failure-path teardown (the
+        # join/terminate below still reaps it)
+        deadline = time.monotonic() + 2.0
+        for conn in self._conns:
+            try:
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not conn.poll(remaining):
+                        break
+                    kind, payload = conn.recv()
+                    if kind == "closed":
+                        if payload and telemetry.enabled():
+                            for name, value in payload.items():
+                                telemetry.inc(name, int(value))
+                        break
+            except (EOFError, BrokenPipeError, OSError):
                 pass
         for proc in self._procs:
             proc.join(timeout=5)

@@ -32,12 +32,12 @@ production callers just let it default to ``time.perf_counter``.
 from __future__ import annotations
 
 import time
-from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ddls_tpu import telemetry
 from ddls_tpu.envs.baselines import FixedDegreePacking
 from ddls_tpu.envs.obs import EDGE_FEATURE_DIM, NODE_FEATURE_DIM
 from ddls_tpu.serve.bucketing import (BucketOverflowError, BucketSpec,
@@ -154,56 +154,165 @@ class ServeResponse:
 # above the window stay exact forever)
 STATS_WINDOW = 8192
 
+# batch-fill fractions land in (0, 1]: an eighth-ladder matches the
+# default max_batch=8 (one bucket per possible fill count)
+_OCCUPANCY_BUCKETS = tuple((i + 1) / 8 for i in range(8))
 
-@dataclass
+
 class ServeStats:
-    """Serving counters; ``summary()`` is the JSON-friendly rollup.
-    Counts are exact over the server's lifetime; the latency percentiles
-    and mean occupancy are over the trailing ``STATS_WINDOW`` samples."""
-    n_requests: int = 0
-    n_policy: int = 0
-    n_fallback: int = 0
-    fallback_reasons: Dict[str, int] = field(default_factory=dict)
-    bucket_hits: Dict[int, int] = field(default_factory=dict)
-    n_flushes: int = 0
-    n_compiles: int = 0
-    latencies_s: "deque" = field(
-        default_factory=lambda: deque(maxlen=STATS_WINDOW))
-    occupancies: "deque" = field(
-        default_factory=lambda: deque(maxlen=STATS_WINDOW))
+    """Serving accounting on the shared telemetry primitives (ISSUE 3):
+    counters + fixed-bucket latency/occupancy histograms in a PRIVATE
+    always-on ``telemetry.Registry`` — per-server isolation (concurrent
+    servers must never share counters) and independence from the global
+    telemetry enable switch (serve's counters are part of its contract,
+    pinned bit-equal by tests/test_serve.py). ``summary()`` keeps its
+    JSON shape; percentiles/occupancy read the histograms' trailing
+    ``STATS_WINDOW`` windows — the exact semantics the hand-rolled deques
+    had. ``registry.snapshot()`` is the bench/report surface.
+    """
+
+    def __init__(self, registry: Optional[telemetry.Registry] = None):
+        self.registry = (registry if registry is not None
+                         else telemetry.Registry(enabled=True))
+        r = self.registry
+        self._requests = r.counter("serve.requests")
+        self._policy = r.counter("serve.policy")
+        self._fallback = r.counter("serve.fallback")
+        self._flushes = r.counter("serve.flushes")
+        self._degraded = r.counter("serve.degraded_transitions")
+        self._compiles = r.gauge("serve.compiles")
+        self._latency = r.histogram("serve.latency_s",
+                                    window=STATS_WINDOW)
+        self._occupancy = r.histogram("serve.batch_occupancy",
+                                      buckets=_OCCUPANCY_BUCKETS,
+                                      window=STATS_WINDOW)
+
+    # --------------------------------------------------------------- intake
+    def record_request(self) -> None:
+        self._requests.inc()
+
+    def record_bucket_hit(self, bucket_idx: int) -> None:
+        self.registry.counter(f"serve.bucket_hits.{bucket_idx}").inc()
 
     def record_response(self, resp: ServeResponse) -> None:
-        self.latencies_s.append(resp.latency_s)
+        self._latency.observe(resp.latency_s)
         if resp.source == "policy":
-            self.n_policy += 1
+            self._policy.inc()
         else:
-            self.n_fallback += 1
-            self.fallback_reasons[resp.reason] = (
-                self.fallback_reasons.get(resp.reason, 0) + 1)
+            self._fallback.inc()
+            self.registry.counter(
+                f"serve.fallback_reason.{resp.reason}").inc()
 
-    def record_flush(self, fill: int, capacity: int) -> None:
-        self.n_flushes += 1
-        self.occupancies.append(fill / capacity)
+    def record_flush(self, fill: int, capacity: int,
+                     bucket_idx: Optional[int] = None,
+                     cause: Optional[str] = None) -> None:
+        self._flushes.inc()
+        occ = fill / capacity
+        self._occupancy.observe(occ)
+        if bucket_idx is not None:
+            self.registry.histogram(
+                f"serve.batch_occupancy.bucket{bucket_idx}",
+                buckets=_OCCUPANCY_BUCKETS,
+                window=STATS_WINDOW).observe(occ)
+        if cause is not None:
+            self.registry.counter(f"serve.flush_cause.{cause}").inc()
+
+    def record_degraded_transition(self) -> None:
+        self._degraded.inc()
+
+    # ------------------------------------------------------------ readbacks
+    def _prefixed_counts(self, prefix: str) -> Dict[str, int]:
+        return {name[len(prefix):]: value
+                for name, value in self.registry.counter_items()
+                if name.startswith(prefix)}
+
+    @property
+    def n_requests(self) -> int:
+        return self._requests.value
+
+    @property
+    def n_policy(self) -> int:
+        return self._policy.value
+
+    @property
+    def n_fallback(self) -> int:
+        return self._fallback.value
+
+    @property
+    def n_flushes(self) -> int:
+        return self._flushes.value
+
+    @property
+    def degraded_transitions(self) -> int:
+        return self._degraded.value
+
+    @property
+    def n_compiles(self) -> int:
+        return int(self._compiles.value or 0)
+
+    @n_compiles.setter
+    def n_compiles(self, value: int) -> None:
+        self._compiles.set(int(value))
+
+    @property
+    def fallback_reasons(self) -> Dict[str, int]:
+        return self._prefixed_counts("serve.fallback_reason.")
+
+    @property
+    def flush_causes(self) -> Dict[str, int]:
+        return self._prefixed_counts("serve.flush_cause.")
+
+    @property
+    def bucket_hits(self) -> Dict[int, int]:
+        return {int(k): v
+                for k, v in self._prefixed_counts(
+                    "serve.bucket_hits.").items()}
+
+    @property
+    def latencies_s(self):
+        return self._latency.window
+
+    @property
+    def occupancies(self):
+        return self._occupancy.window
+
+    def per_bucket_occupancy(self) -> Dict[int, float]:
+        """Mean batch-fill fraction per bucket ladder rung (over the
+        trailing window) — the --stats-interval line's occupancy field."""
+        out = {}
+        for name, h in self.registry.histogram_items():
+            if name.startswith("serve.batch_occupancy.bucket"):
+                vals = h.window_values()
+                if vals:
+                    idx = int(name[len("serve.batch_occupancy.bucket"):])
+                    out[idx] = float(np.mean(
+                        np.asarray(vals, dtype=np.float64)))
+        return out
 
     def summary(self) -> Dict[str, Any]:
-        lat = np.asarray(self.latencies_s, dtype=np.float64)
+        n_requests = self.n_requests
+        n_fallback = self.n_fallback
+        lat = self._latency
         return {
-            "n_requests": self.n_requests,
+            "n_requests": n_requests,
             "n_policy": self.n_policy,
-            "n_fallback": self.n_fallback,
-            "fallback_rate": (self.n_fallback / self.n_requests
-                              if self.n_requests else 0.0),
-            "fallback_reasons": dict(self.fallback_reasons),
+            "n_fallback": n_fallback,
+            "fallback_rate": (n_fallback / n_requests
+                              if n_requests else 0.0),
+            "fallback_reasons": self.fallback_reasons,
             "bucket_hits": {str(k): v
                             for k, v in sorted(self.bucket_hits.items())},
             "n_flushes": self.n_flushes,
             "n_compiles": self.n_compiles,
-            "p50_latency_ms": (float(np.percentile(lat, 50)) * 1e3
-                               if len(lat) else None),
-            "p99_latency_ms": (float(np.percentile(lat, 99)) * 1e3
-                               if len(lat) else None),
-            "batch_occupancy": (float(np.mean(self.occupancies))
-                                if self.occupancies else None),
+            "p50_latency_ms": (lat.percentile(50) * 1e3
+                               if lat.count else None),
+            "p99_latency_ms": (lat.percentile(99) * 1e3
+                               if lat.count else None),
+            "batch_occupancy": (float(np.mean(np.asarray(
+                self._occupancy.window_values(), dtype=np.float64)))
+                                if self._occupancy.count else None),
+            "flush_causes": self.flush_causes,
+            "degraded_transitions": self.degraded_transitions,
         }
 
 
@@ -344,7 +453,7 @@ class PolicyServer:
         now = self.clock() if now is None else now
         rid = self._next_id
         self._next_id += 1
-        self.stats.n_requests += 1
+        self.stats.record_request()
         self._submit_time[rid] = now
 
         # fallback answers complete at the clock's now, not the (possibly
@@ -366,7 +475,7 @@ class PolicyServer:
         except BucketOverflowError:
             self._resolve_fallback(rid, obs, self.clock(), reason="overflow")
             return rid
-        self.stats.bucket_hits[idx] = self.stats.bucket_hits.get(idx, 0) + 1
+        self.stats.record_bucket_hit(idx)
         self.engine.submit(PendingRequest(
             request_id=rid, bucket_idx=idx, obs=bucketed,
             enqueue_time=now, meta=meta))
@@ -380,7 +489,8 @@ class PolicyServer:
         real_time = now is None
         now = self.clock() if real_time else now
         for idx, reqs in self.engine.due_batches(now, force=force):
-            self._run_batch(idx, reqs, now, reread_clock=real_time)
+            self._run_batch(idx, reqs, now, reread_clock=real_time,
+                            force=force)
         out, self._ready = self._ready, []
         return out
 
@@ -408,8 +518,14 @@ class PolicyServer:
 
     # --------------------------------------------------------------- internal
     def _run_batch(self, bucket_idx: int, reqs: List[PendingRequest],
-                   now: float, reread_clock: bool = True) -> None:
-        self.stats.record_flush(len(reqs), self.engine.max_batch)
+                   now: float, reread_clock: bool = True,
+                   force: bool = False) -> None:
+        # flush-cause attribution: a full batch always means fill (the
+        # engine pops full batches before deadline/force partials)
+        cause = ("fill" if len(reqs) >= self.engine.max_batch
+                 else ("drain" if force else "deadline"))
+        self.stats.record_flush(len(reqs), self.engine.max_batch,
+                                bucket_idx=bucket_idx, cause=cause)
         try:
             stacked, n_real = self._forward.stack([r.obs for r in reqs])
         except Exception:
@@ -431,6 +547,11 @@ class PolicyServer:
             # device path to later requests. Real-time mode re-reads the
             # clock so the (possibly seconds-long) failed forward is
             # charged to these requests' latency, same as the policy path.
+            if not self.degraded:
+                self.stats.record_degraded_transition()
+                telemetry.record_event("serve_degraded",
+                                       bucket_idx=bucket_idx,
+                                       batch_fill=len(reqs))
             self.degraded = True
             done = self.clock() if reread_clock else now
             for r in reqs:

@@ -18,6 +18,7 @@ from __future__ import annotations
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
+from ddls_tpu import telemetry as _telemetry
 from ddls_tpu.demands.job import Job
 from ddls_tpu.graphs.readers import backward_op_id
 from ddls_tpu.sim.comm_model import one_to_one_time, ramp_all_reduce_time
@@ -72,6 +73,9 @@ class OpPartition:
             model = job.details["model"]
             cache_key = (model, tuple(sorted(split_fwd.items())))
             cached = cluster.partition_cache.get(cache_key)
+            if _telemetry.enabled():
+                _telemetry.inc("sim.partition_cache.hit" if cached is not None
+                               else "sim.partition_cache.miss")
             if cached is None:
                 pgraph = partition_graph(job.graph, self.action[job_id])
                 cached = {"graph": pgraph, "immutable": None}

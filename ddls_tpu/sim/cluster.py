@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
+from ddls_tpu import telemetry as _telemetry
 from ddls_tpu.demands.job import Job
 from ddls_tpu.demands.job_queue import JobQueue
 from ddls_tpu.demands.jobs_generator import JobsGenerator
@@ -491,13 +492,23 @@ class RampClusterEnvironment:
             if cached is None:
                 # explicit jax opt-in outranks the auto-enabled native
                 # engine; host engine is the always-correct fallback
+                backend = "host"
                 if self.use_jax_lookahead:
                     cached = self._run_jax_lookahead(job)
+                    if cached is not None:
+                        backend = "jax"
                 if cached is None and self.use_native_lookahead:
                     cached = self._run_native_lookahead(job)
+                    if cached is not None:
+                        backend = "native"
                 if cached is None:  # disabled, or padding/shape fallback
                     cached = self._run_lookahead(job)
                 self.lookahead_cache[key] = cached
+                if _telemetry.enabled():
+                    _telemetry.inc("sim.lookahead_cache.miss")
+                    _telemetry.inc(f"sim.lookahead.backend.{backend}")
+            elif _telemetry.enabled():
+                _telemetry.inc("sim.lookahead_cache.hit")
             # one simulated training step happened for this job, whichever
             # backend (host/native/jax) served it and whether or not the
             # memo cache did — keeps job.training_step_counter meaningful

@@ -1,0 +1,148 @@
+"""Unified telemetry layer: spans, counters, gauges, latency histograms
+(ISSUE 3) — one vocabulary for timing/attribution evidence across the
+simulator, the train loops, the serve stack, and bench.py.
+
+The process-global registry here is **disabled by default** and the
+module-level API is a near-no-op while it stays disabled: one bool check,
+a shared singleton span, no metric creation, no allocation. That is the
+hot-path contract (CLAUDE.md): sim/env/train code may only touch
+telemetry through these gated functions, so golden tests and the env
+step loop are byte- and speed-identical with telemetry off
+(tests/test_telemetry.py pins both).
+
+Usage::
+
+    from ddls_tpu import telemetry
+
+    telemetry.enable(sink_path="run.jsonl")      # CLI entry points
+    with telemetry.span("train.collect"):
+        ...
+    telemetry.inc("sim.lookahead_cache.hit")
+    telemetry.record_event("tpu_probe", phase="timeout",
+                           wedge_suspected=True)
+    print(telemetry.snapshot())                  # JSON-friendly rollup
+
+Opt-in ``jax.profiler`` capture: ``enable(jax_trace_dir=...,
+jax_trace_spans=("train.train_step",))`` makes the first matching span
+per process wrap a ``jax.profiler`` trace (TensorBoard/Perfetto), tying
+device timelines to the same span names the histograms use.
+
+Subsystems that need isolated, always-on metrics (serve's per-server
+stats) instantiate a private ``Registry(enabled=True)`` instead of the
+global one — multiple servers must never share counters, and their stats
+must keep working with global telemetry disabled.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Sequence
+
+from ddls_tpu.telemetry.metrics import (DEFAULT_LATENCY_BUCKETS_S,
+                                        DEFAULT_WINDOW, NULL_SPAN, Counter,
+                                        Gauge, Histogram, NullSpan,
+                                        Registry, Span,
+                                        percentile_from_bucket_counts)
+from ddls_tpu.telemetry.sink import JsonlSink
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "Span", "NullSpan",
+    "NULL_SPAN", "JsonlSink", "DEFAULT_LATENCY_BUCKETS_S",
+    "DEFAULT_WINDOW", "percentile_from_bucket_counts",
+    "registry", "enabled", "enable", "disable", "span", "inc", "observe",
+    "set_gauge", "record_event", "snapshot", "span_summaries", "reset",
+    "dump_snapshot",
+]
+
+_GLOBAL = Registry(enabled=False)
+
+# environment override for processes whose CLI has no telemetry flag
+# (subprocess env workers, the bench's sim-mode rider): a path enables
+# the global registry with a JSONL sink at import of the entry point
+# that consults it (bench.py, scripts/serve_policy.py)
+SINK_ENV_VAR = "DDLS_TELEMETRY_JSONL"
+
+
+def registry() -> Registry:
+    """The process-global registry (for snapshot plumbing and tests —
+    hot paths go through the gated module functions below)."""
+    return _GLOBAL
+
+
+def enabled() -> bool:
+    return _GLOBAL.enabled
+
+
+def enable(sink_path: Optional[str] = None,
+           clock=None,
+           jax_trace_dir: Optional[str] = None,
+           jax_trace_spans: Sequence[str] = ()) -> Registry:
+    """Turn the global registry on (idempotent; existing metrics are
+    kept — call ``reset()`` first for a fresh measurement window).
+    ``sink_path`` attaches a JSONL sink; ``jax_trace_dir`` +
+    ``jax_trace_spans`` arm the opt-in jax.profiler capture."""
+    if sink_path:
+        _GLOBAL.sink = JsonlSink(sink_path)
+    if clock is not None:
+        _GLOBAL.clock = clock
+    if jax_trace_dir:
+        _GLOBAL.jax_trace_dir = str(jax_trace_dir)
+        _GLOBAL._jax_trace_done = False  # arm a fresh one-shot capture
+    if jax_trace_spans:
+        _GLOBAL.jax_trace_spans = frozenset(jax_trace_spans)
+    _GLOBAL.enabled = True
+    return _GLOBAL
+
+
+def disable() -> None:
+    """Flip telemetry off; recorded metrics survive until ``reset()``."""
+    _GLOBAL.enabled = False
+
+
+def env_sink_path() -> Optional[str]:
+    return os.environ.get(SINK_ENV_VAR) or None
+
+
+# ----------------------------------------------------------- gated hot API
+def span(name: str):
+    """A timed block; the shared no-op singleton when disabled (so a hot
+    loop allocates nothing — identity-tested by the guard test)."""
+    if not _GLOBAL.enabled:
+        return NULL_SPAN
+    return Span(_GLOBAL, name)
+
+
+def inc(name: str, n: int = 1) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.counter(name).inc(n)
+
+
+def observe(name: str, value: float, **histogram_kwargs) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.histogram(name, **histogram_kwargs).observe(value)
+
+
+def set_gauge(name: str, value: float) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.gauge(name).set(value)
+
+
+def record_event(kind: str, **fields) -> None:
+    if _GLOBAL.enabled:
+        _GLOBAL.event(kind, **fields)
+
+
+# --------------------------------------------------------------- readbacks
+def snapshot() -> Dict[str, Any]:
+    return _GLOBAL.snapshot()
+
+
+def span_summaries() -> Dict[str, Dict[str, float]]:
+    return _GLOBAL.span_summaries()
+
+
+def reset() -> None:
+    _GLOBAL.reset()
+
+
+def dump_snapshot(extra: Optional[Dict[str, Any]] = None) -> None:
+    _GLOBAL.dump_snapshot(extra=extra)

@@ -1,0 +1,486 @@
+"""Dependency-free metrics primitives: counters, gauges, fixed-bucket
+latency histograms, span tracing, and a registry with snapshot/reset.
+
+The substrate Podracer (arXiv 2104.06272) and MSRL (arXiv 2210.00882)
+attribute their scaling wins to: per-stage instrumentation of the
+actor/learner dataflow, here shared by the simulator, the train loops,
+the serve stack, and bench.py so every perf claim speaks one vocabulary.
+
+Design rules (ISSUE 3):
+
+* **Near-no-op when disabled.** The module-level API in
+  ``ddls_tpu.telemetry`` early-outs on a single bool and returns one
+  shared singleton span object, so a disabled hot loop performs no
+  allocation and creates no metrics (guard-tested in
+  tests/test_telemetry.py). Hot-path modules must only ever go through
+  that gated API — never instantiate metrics per step.
+* **Thread-safe aggregation.** Every mutation takes the metric's own
+  lock (serve batches, background save threads, and the multi-host
+  launcher all touch metrics off the main thread); registry
+  create-or-get takes the registry lock.
+* **Injectable clock.** ``Registry(clock=...)`` parameterises every
+  span/duration measurement, so tests drive time deterministically —
+  the same discipline as ``PolicyServer(clock=...)``.
+* **Histograms carry fixed buckets AND a trailing sample window.** The
+  bucket counts are exact over the metric's lifetime (what a JSONL sink
+  or a cross-process aggregator can merge); the window gives exact
+  ``np.percentile`` p50/p95/p99 over the last ``window`` samples — the
+  same windowed-percentile semantics serve's stats always had, so
+  histogram-derived latency figures agree bit-for-bit with them.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+# geometric ~1-2.5-5 ladder from 10 us to 30 s: spans range from a
+# sub-ms host env step to a multi-second tunnelled-TPU compile
+DEFAULT_LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3,
+    1e-2, 2.5e-2, 5e-2, 1e-1, 2.5e-1, 5e-1, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+# trailing-window size for exact percentiles: a long-lived process must
+# not hold one float per observation ever made (matches serve's
+# STATS_WINDOW; the bucket counts above the window stay exact forever)
+DEFAULT_WINDOW = 8192
+
+
+class Counter:
+    """Monotonic event counter."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-value-wins instantaneous measurement."""
+
+    __slots__ = ("name", "_lock", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._value: Optional[float] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram + trailing raw-sample window.
+
+    ``buckets`` are ascending upper bounds (``le`` convention: a sample
+    lands in the first bucket whose bound it does not exceed; one
+    implicit overflow bucket catches the rest). Bucket counts, count,
+    sum, min and max are exact over the histogram's lifetime; the
+    percentiles are exact (``np.percentile``, linear interpolation) over
+    the trailing ``window`` samples, falling back to bucket
+    interpolation when the window is disabled (``window=0``).
+    """
+
+    __slots__ = ("name", "bounds", "_counts", "_count", "_sum", "_min",
+                 "_max", "window", "_lock")
+
+    def __init__(self, name: str,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
+                 window: int = DEFAULT_WINDOW):
+        self.name = name
+        self.bounds = tuple(sorted(float(b) for b in buckets))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.bounds) + 1)  # + overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self.window: Optional[deque] = (deque(maxlen=int(window))
+                                        if window else None)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._counts[bisect.bisect_left(self.bounds, value)] += 1
+            self._count += 1
+            self._sum += value
+            if self._min is None or value < self._min:
+                self._min = value
+            if self._max is None or value > self._max:
+                self._max = value
+            if self.window is not None:
+                self.window.append(value)
+
+    # ------------------------------------------------------------- readbacks
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def min(self) -> Optional[float]:
+        return self._min
+
+    @property
+    def max(self) -> Optional[float]:
+        return self._max
+
+    def window_values(self) -> list:
+        """Copy of the trailing window taken under the lock — the only
+        safe way to iterate it while another thread may be observing
+        (a deque append during iteration raises RuntimeError)."""
+        if self.window is None:
+            return []
+        with self._lock:
+            return list(self.window)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Exact percentile over the trailing window (the semantics serve
+        stats always used); bucket-interpolated when no window exists."""
+        vals = self.window_values()
+        if vals:
+            return float(np.percentile(
+                np.asarray(vals, dtype=np.float64), q))
+        if self._count:
+            return self.percentile_from_buckets(q)
+        return None
+
+    def percentile_from_buckets(self, q: float) -> Optional[float]:
+        """Approximate percentile by linear interpolation inside the
+        bucket holding the target rank (the only percentile available to
+        an aggregator that sees bucket counts alone, e.g.
+        scripts/telemetry_report.py over merged sink snapshots)."""
+        return percentile_from_bucket_counts(
+            self.bounds, self._counts, q, lo=self._min, hi=self._max)
+
+    def bucket_counts(self) -> Dict[str, int]:
+        """Nonzero buckets only, keyed by upper bound ('+inf' overflow)."""
+        out = {}
+        for bound, n in zip(self.bounds, self._counts):
+            if n:
+                out[repr(bound)] = n
+        if self._counts[-1]:
+            out["+inf"] = self._counts[-1]
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        if not self._count:
+            return {"count": 0}
+        return {
+            "count": self._count,
+            "sum": self._sum,
+            "mean": self._sum / self._count,
+            "min": self._min,
+            "max": self._max,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+            "buckets": self.bucket_counts(),
+        }
+
+
+def percentile_from_bucket_counts(bounds: Sequence[float],
+                                  counts: Sequence[int], q: float,
+                                  lo: Optional[float] = None,
+                                  hi: Optional[float] = None
+                                  ) -> Optional[float]:
+    """Shared bucket-interpolation percentile (Histogram +
+    telemetry_report.py): walk the cumulative counts to the bucket
+    containing rank ``q/100 * count`` and interpolate linearly between
+    its bounds, clamped to the observed [lo, hi] when known."""
+    total = int(sum(counts))
+    if not total:
+        return None
+    target = (q / 100.0) * total
+    cum = 0
+    for i, n in enumerate(counts):
+        if not n:
+            continue
+        if cum + n >= target:
+            b_lo = bounds[i - 1] if i > 0 else (lo if lo is not None
+                                                else 0.0)
+            b_hi = (bounds[i] if i < len(bounds)
+                    else (hi if hi is not None else bounds[-1]))
+            if lo is not None:
+                b_lo = max(b_lo, lo) if i == 0 else b_lo
+            if hi is not None:
+                b_hi = min(b_hi, hi)
+            frac = (target - cum) / n
+            return float(b_lo + (b_hi - b_lo) * min(max(frac, 0.0), 1.0))
+        cum += n
+    return float(bounds[-1] if hi is None else hi)
+
+
+class NullSpan:
+    """The shared disabled-path span: a do-nothing context manager
+    returned by ``telemetry.span`` when telemetry is off, so hot loops
+    pay one bool check and zero allocations per call."""
+
+    __slots__ = ()
+
+    duration_s = 0.0
+
+    def __enter__(self) -> "NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+    def elapsed(self) -> float:
+        return 0.0
+
+
+NULL_SPAN = NullSpan()
+
+
+class Span:
+    """One timed block: ``with registry.span("collect"): ...`` records
+    the duration into the registry's span histogram (and the JSONL sink
+    when one is attached). ``duration_s`` is set on exit; ``elapsed()``
+    reads the running clock mid-span."""
+
+    __slots__ = ("_registry", "name", "_t0", "duration_s",
+                 "_owns_jax_trace")
+
+    def __init__(self, registry: "Registry", name: str):
+        self._registry = registry
+        self.name = name
+        self._t0 = 0.0
+        self.duration_s = 0.0
+        self._owns_jax_trace = False
+
+    def __enter__(self) -> "Span":
+        reg = self._registry
+        # opt-in jax.profiler capture: ONE trace per process — the first
+        # configured span to enter owns it (jax supports a single active
+        # trace), stops it on ITS exit (instance ownership, so a nested
+        # or repeated same-name span can neither stop the outer trace
+        # early nor re-arm a second capture)
+        if (reg.jax_trace_dir and not reg._jax_tracing
+                and not reg._jax_trace_done
+                and self.name in reg.jax_trace_spans):
+            try:
+                import jax
+
+                jax.profiler.start_trace(str(reg.jax_trace_dir))
+                reg._jax_tracing = self.name
+                self._owns_jax_trace = True
+            except Exception:
+                pass  # profiling must never break the measured code
+        self._t0 = reg.clock()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        reg = self._registry
+        self.duration_s = reg.clock() - self._t0
+        reg._record_span(self.name, self.duration_s)
+        if self._owns_jax_trace:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            reg._jax_tracing = None
+            reg._jax_trace_done = True
+            self._owns_jax_trace = False
+        return False
+
+    def elapsed(self) -> float:
+        return self._registry.clock() - self._t0
+
+
+class Registry:
+    """A named collection of metrics + span tracer + optional sink.
+
+    The process-global instance lives in ``ddls_tpu.telemetry`` (disabled
+    by default; hot paths reach it only through the gated module API).
+    Private instances are cheap and always-on — serve's per-server stats
+    use one so concurrent servers never share counters and stats work
+    with global telemetry disabled.
+    """
+
+    def __init__(self, enabled: bool = True,
+                 clock: Callable[[], float] = time.perf_counter,
+                 sink=None):
+        self.enabled = bool(enabled)
+        self.clock = clock
+        self.sink = sink
+        self.jax_trace_dir: Optional[str] = None
+        self.jax_trace_spans: frozenset = frozenset()
+        self._jax_tracing: Optional[str] = None
+        self._jax_trace_done = False  # one capture per process/registry
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+        self._spans: Dict[str, Histogram] = {}
+
+    # ------------------------------------------------------------- metrics
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS_S,
+                  window: int = DEFAULT_WINDOW) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(
+                    name, buckets=buckets, window=window)
+            return h
+
+    def histogram_items(self):
+        """Live (name, Histogram) pairs — read-side iteration for rollups
+        (e.g. serve's per-bucket occupancy line)."""
+        with self._lock:
+            return list(self._histograms.items())
+
+    def counter_items(self):
+        """Live (name, value) counter pairs — a cheap read (dict copy
+        under the lock) for callers that only need counters; a full
+        ``snapshot()`` would also summarise every histogram."""
+        with self._lock:
+            return [(n, c.value) for n, c in self._counters.items()]
+
+    # ---------------------------------------------------- state swapping
+    def metrics_state(self) -> tuple:
+        """Opaque handle to the CURRENT metric dicts. ``reset()`` swaps
+        in fresh dicts rather than mutating, so a caller that needs a
+        private measurement window (bench.main) can save this, reset,
+        measure, and hand the handle back to ``restore_metrics_state`` —
+        the previous owner's metrics come back untouched."""
+        with self._lock:
+            return (self._counters, self._gauges, self._histograms,
+                    self._spans)
+
+    def restore_metrics_state(self, state: tuple) -> None:
+        with self._lock:
+            (self._counters, self._gauges, self._histograms,
+             self._spans) = state
+
+    # --------------------------------------------------------------- spans
+    def span(self, name: str) -> Span:
+        return Span(self, name)
+
+    def _record_span(self, name: str, duration_s: float) -> None:
+        with self._lock:
+            h = self._spans.get(name)
+            if h is None:
+                h = self._spans[name] = Histogram(name)
+        h.observe(duration_s)
+        sink = self.sink
+        if sink is not None:
+            sink.write({"type": "span", "name": name,
+                        "dur_s": duration_s})
+
+    def span_summaries(self) -> Dict[str, Dict[str, float]]:
+        """Per-span rollup in the units humans read spans in (ms), the
+        shape both ``snapshot()['spans']`` and the W&B flatten emit."""
+        out = {}
+        with self._lock:
+            spans = dict(self._spans)
+        for name, h in spans.items():
+            if not h.count:
+                continue
+            out[name] = {
+                "count": h.count,
+                "total_s": h.sum,
+                "mean_ms": h.sum / h.count * 1e3,
+                "p50_ms": h.percentile(50) * 1e3,
+                "p95_ms": h.percentile(95) * 1e3,
+                "p99_ms": h.percentile(99) * 1e3,
+                "max_ms": (h.max or 0.0) * 1e3,
+            }
+        return out
+
+    # -------------------------------------------------------------- events
+    def event(self, kind: str, **fields) -> None:
+        """A discrete occurrence (e.g. a TPU probe outcome): tallied as a
+        counter (``event.<kind>``, plus ``event.<kind>.<phase>`` when a
+        ``phase`` field is given) and written verbatim to the sink so the
+        trail survives the process."""
+        name = f"event.{kind}"
+        self.counter(name).inc()
+        phase = fields.get("phase")
+        if phase is not None:
+            self.counter(f"{name}.{phase}").inc()
+        sink = self.sink
+        if sink is not None:
+            sink.write({"type": "event", "kind": kind, **fields})
+
+    # ----------------------------------------------------- snapshot / reset
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-friendly dump of every live metric; empty sections are
+        omitted (a registry that recorded nothing snapshots to ``{}``)."""
+        with self._lock:
+            counters = {n: c.value for n, c in self._counters.items()}
+            gauges = {n: g.value for n, g in self._gauges.items()
+                      if g.value is not None}
+            hists = dict(self._histograms)
+        out: Dict[str, Any] = {}
+        if counters:
+            out["counters"] = counters
+        if gauges:
+            out["gauges"] = gauges
+        hist_section = {n: h.summary() for n, h in hists.items() if h.count}
+        if hist_section:
+            out["histograms"] = hist_section
+        spans = self.span_summaries()
+        if spans:
+            out["spans"] = spans
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric and span (fresh dicts — outstanding handles
+        keep counting into orphaned objects, which is the safe failure
+        mode for a racing thread)."""
+        with self._lock:
+            self._counters = {}
+            self._gauges = {}
+            self._histograms = {}
+            self._spans = {}
+
+    def dump_snapshot(self, extra: Optional[Dict[str, Any]] = None) -> None:
+        """Write the current snapshot to the sink (no-op without one)."""
+        sink = self.sink
+        if sink is not None:
+            data = self.snapshot()
+            if extra:
+                data = {**data, **extra}
+            sink.write({"type": "snapshot", "data": data})

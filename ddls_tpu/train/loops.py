@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ddls_tpu import telemetry
 from ddls_tpu.utils.common import (available_cores, get_class_from_path,
                                    seed_everything)
 
@@ -433,16 +434,28 @@ class RLEpochLoop:
         return sub
 
     def run(self) -> Dict[str, Any]:
-        """Collect one trajectory batch and apply one PPO update."""
+        """Collect one trajectory batch and apply one PPO update.
+
+        Per-update phase spans (no-ops while telemetry is disabled): note
+        jax dispatch is async, so ``train.train_step`` measures trace/
+        dispatch and ``train.host_sync`` absorbs the device wait — the
+        pair is the update's wall cost, the split shows where the host
+        blocked (the attribution Podracer/MSRL instrument for)."""
         import jax
 
         start = time.time()
-        out = self.collector.collect(self.state.params,
-                                     self._split_collect_rng())
-        straj, slv = self.learner.shard_traj(out["traj"], out["last_values"])
-        self.state, metrics = self.learner.train_step(
-            self.state, straj, slv, self._split_rng())
-        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        with telemetry.span("train.collect"):
+            out = self.collector.collect(self.state.params,
+                                         self._split_collect_rng())
+        with telemetry.span("train.device_transfer"):
+            straj, slv = self.learner.shard_traj(out["traj"],
+                                                 out["last_values"])
+        with telemetry.span("train.train_step"):
+            self.state, metrics = self.learner.train_step(
+                self.state, straj, slv, self._split_rng())
+        with telemetry.span("train.host_sync"):
+            metrics = {k: float(v)
+                       for k, v in jax.device_get(metrics).items()}
 
         self.epoch_counter += 1
         self.total_env_steps += out["env_steps"]
@@ -463,7 +476,9 @@ class RLEpochLoop:
 
         if (self.evaluation_interval
                 and self.epoch_counter % self.evaluation_interval == 0):
-            results["evaluation"] = self.evaluate(self.evaluation_duration)
+            with telemetry.span("train.eval"):
+                results["evaluation"] = self.evaluate(
+                    self.evaluation_duration)
         self.run_time += time.time() - start
         results["epoch_time"] = time.time() - start
         results["run_time"] = self.run_time
@@ -659,6 +674,12 @@ class RLEpochLoop:
                 flat[prefix[:-1]] = float(node)
 
         walk(results)
+        # telemetry phase spans ride the same flatten (one vocabulary for
+        # per-update timing whether read from W&B or a snapshot)
+        if telemetry.enabled():
+            for name, summ in telemetry.span_summaries().items():
+                for key, value in summ.items():
+                    flat[f"telemetry/span/{name}/{key}"] = float(value)
         self.wandb.log(flat)
 
     def close(self) -> None:
@@ -721,25 +742,28 @@ class ApexDQNEpochLoop(RLEpochLoop):
         start = time.time()
         T, B = self.rollout_length, self.num_envs
 
-        for _ in range(T):
-            batched = stack_obs(self.vec_env.obs)
-            eps = per_worker_epsilons(B, self.total_env_steps, cfg)
-            actions = np.asarray(self.learner.sample_actions(
-                self.state.params, batched, self._split_collect_rng(), eps))
-            prev_obs = list(self.vec_env.obs)
-            _, rewards, dones = self.vec_env.step(actions)
-            for i in range(B):
-                queue = self._nstep_queues[i]
-                queue.append({
-                    "obs": slim(prev_obs[i]), "action": int(actions[i]),
-                    "reward": float(rewards[i]), "done": bool(dones[i]),
-                    # at episode end this is the auto-reset obs, but then
-                    # discount == 0 so the target never reads it
-                    "next_obs": slim(self.vec_env.obs[i])})
-                for tr in nstep_transitions(queue, cfg.n_step, cfg.gamma,
-                                            flush=bool(dones[i])):
-                    self.replay.add(tr)
-            self.total_env_steps += B
+        with telemetry.span("train.collect"):
+            for _ in range(T):
+                batched = stack_obs(self.vec_env.obs)
+                eps = per_worker_epsilons(B, self.total_env_steps, cfg)
+                actions = np.asarray(self.learner.sample_actions(
+                    self.state.params, batched, self._split_collect_rng(),
+                    eps))
+                prev_obs = list(self.vec_env.obs)
+                _, rewards, dones = self.vec_env.step(actions)
+                for i in range(B):
+                    queue = self._nstep_queues[i]
+                    queue.append({
+                        "obs": slim(prev_obs[i]), "action": int(actions[i]),
+                        "reward": float(rewards[i]), "done": bool(dones[i]),
+                        # at episode end this is the auto-reset obs, but
+                        # then discount == 0 so the target never reads it
+                        "next_obs": slim(self.vec_env.obs[i])})
+                    for tr in nstep_transitions(queue, cfg.n_step,
+                                                cfg.gamma,
+                                                flush=bool(dones[i])):
+                        self.replay.add(tr)
+                self.total_env_steps += B
 
         env_steps = T * B
         metrics_acc: List[Dict[str, float]] = []
@@ -766,11 +790,17 @@ class ApexDQNEpochLoop(RLEpochLoop):
                           "next_obs": batch["next_obs"],
                           "discounts": batch["discount"],
                           "weights": weights}
-                self.state, metrics, td = self.learner.train_step(
-                    self.state, tbatch)
-                self.replay.update_priorities(idx, td)
-                metrics_acc.append({k: float(v) for k, v in
-                                    jax.device_get(metrics).items()})
+                with telemetry.span("train.train_step"):
+                    self.state, metrics, td = self.learner.train_step(
+                        self.state, tbatch)
+                # host-side replay work gets its own span: train.host_sync
+                # must attribute DEVICE wait only (run() docstring), not
+                # priority-update CPU time
+                with telemetry.span("train.replay_update"):
+                    self.replay.update_priorities(idx, td)
+                with telemetry.span("train.host_sync"):
+                    metrics_acc.append({k: float(v) for k, v in
+                                        jax.device_get(metrics).items()})
 
         self.epoch_counter += 1
         learner_metrics = ({k: float(np.mean([m[k] for m in metrics_acc]))
@@ -937,17 +967,21 @@ class ESEpochLoop(RLEpochLoop):
         # fitness average to reduce variance. Only perturb/gate draws come
         # from the shared stream (they feed the update / guard a branch)
         noise_rng = self._split_collect_rng()
-        stacked, eps = self.learner.perturb(self.state.params, perturb_rng)
-        fitness = self.learner.evaluate_population(
-            stacked, self.vec_env, window=self.rollout_length,
-            rng=noise_rng)
+        with telemetry.span("train.collect"):
+            stacked, eps = self.learner.perturb(self.state.params,
+                                                perturb_rng)
+            fitness = self.learner.evaluate_population(
+                stacked, self.vec_env, window=self.rollout_length,
+                rng=noise_rng)
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
             fitness = np.mean(
                 multihost_utils.process_allgather(
                     np.asarray(fitness, np.float32)), axis=0)
-        self.state, metrics = self.learner.update(self.state, eps, fitness)
+        with telemetry.span("train.train_step"):
+            self.state, metrics = self.learner.update(self.state, eps,
+                                                      fitness)
         metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
         # training episodes are drained BEFORE any eval window so the eval
         # policy's episodes can never leak into the training stats

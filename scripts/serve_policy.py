@@ -204,6 +204,16 @@ def main(argv=None) -> int:
     parser.add_argument("--probe-timeout", type=float, default=240.0,
                         help="bounded backend-init probe before serving "
                              "(production path only; falls back to cpu)")
+    parser.add_argument("--stats-interval", type=float, default=None,
+                        help="print a one-line telemetry snapshot "
+                             "(decisions/s, p99, fallback rate, per-bucket"
+                             " occupancy) to STDERR every N seconds; the "
+                             "stdout JSON protocol is untouched")
+    parser.add_argument("--telemetry-jsonl", default=None,
+                        help="append telemetry span/event/snapshot records"
+                             " to this JSONL sink (summarize with "
+                             "scripts/telemetry_report.py; env fallback: "
+                             "DDLS_TELEMETRY_JSONL)")
     args = parser.parse_args(argv)
 
     if args.selftest:
@@ -213,6 +223,15 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
         return run_selftest(args)
+
+    # telemetry on (before the probe, so probe outcomes — success RTT,
+    # timeout/wedge-suspected — leave a trail) whenever the caller asked
+    # for stats or a sink; otherwise the global registry stays disabled
+    from ddls_tpu import telemetry
+
+    sink_path = args.telemetry_jsonl or telemetry.env_sink_path()
+    if args.stats_interval or sink_path:
+        telemetry.enable(sink_path=sink_path)
 
     # production path: bounded backend probe BEFORE the first in-process
     # jax import — a wedged axon tunnel must cost one timeout at startup,
@@ -296,14 +315,42 @@ def main(argv=None) -> int:
     import select
     import time
 
+    # --stats-interval bookkeeping: the periodic line goes to STDERR (the
+    # stdout JSON protocol carries only decisions), decisions/s is over
+    # the interval window, everything else reads the live stats
+    def stats_line(window_done: int, window_s: float) -> str:
+        s = server.stats.summary()
+        p99 = s["p99_latency_ms"]
+        p99_txt = "n/a" if p99 is None else f"{p99:.2f} ms"
+        occ = " ".join(
+            f"b{idx}={val:.2f}" for idx, val in
+            sorted(server.stats.per_bucket_occupancy().items()))
+        return (f"[serve] {window_done / max(window_s, 1e-9):.1f} dec/s"
+                f" | p99 {p99_txt}"
+                f" | fallback {s['fallback_rate'] * 100:.1f}%"
+                f" | occupancy {occ or '-'}"
+                f" | queued {server.queued()}"
+                f" | degraded {server.degraded}")
+
+    def decisions_done() -> int:
+        return server.stats.n_policy + server.stats.n_fallback
+
     fd = sys.stdin.fileno()
     lines_in = LineAssembler()
     stdin_open = True
+    last_stats_t = time.perf_counter()
+    last_stats_done = 0
     while stdin_open:
+        now = time.perf_counter()
         deadline = server.next_deadline()
-        timeout = (None if deadline is None
-                   else max(0.0, deadline - time.perf_counter()))
-        ready, _, _ = select.select([fd], [], [], timeout)
+        timeouts = []
+        if deadline is not None:
+            timeouts.append(max(0.0, deadline - now))
+        if args.stats_interval:
+            timeouts.append(max(0.0,
+                                last_stats_t + args.stats_interval - now))
+        ready, _, _ = select.select([fd], [], [],
+                                    min(timeouts) if timeouts else None)
         if ready:
             chunk = os.read(fd, 1 << 16)
             if not chunk:
@@ -314,9 +361,22 @@ def main(argv=None) -> int:
                 for line in lines_in.feed(chunk):
                     handle_line(line)
         emit_responses(server.poll())
+        now = time.perf_counter()
+        if (args.stats_interval
+                and now - last_stats_t >= args.stats_interval):
+            done = decisions_done()
+            print(stats_line(done - last_stats_done, now - last_stats_t),
+                  file=sys.stderr, flush=True)
+            last_stats_t = now
+            last_stats_done = done
     emit_responses(server.drain())
     print(json.dumps({"serve_stats": server.stats.summary()}),
           file=sys.stderr, flush=True)
+    if telemetry.enabled():
+        # sink gets the final global + per-server registries (the record
+        # scripts/telemetry_report.py reads counters/histograms from)
+        telemetry.dump_snapshot(
+            extra={"serve": server.stats.registry.snapshot()})
     return 0
 
 

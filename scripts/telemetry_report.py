@@ -1,0 +1,201 @@
+"""Summarize a telemetry JSONL sink file into per-span / per-metric tables.
+
+Usage::
+
+    python scripts/telemetry_report.py run.jsonl
+
+Reads the three record types ``ddls_tpu.telemetry`` writes
+(docs/telemetry.md "Sink format"):
+
+* ``span`` records are aggregated per name into count / total / mean /
+  p50 / p95 / p99 / max (exact percentiles — every duration is on disk);
+* ``event`` records are tallied per (kind, phase) with the last
+  occurrence's fields shown (e.g. the last ``tpu_probe`` outcome);
+* the LAST ``snapshot`` record supplies the counters / gauges /
+  histograms tables (histogram percentiles fall back to fixed-bucket
+  interpolation via ``percentile_from_bucket_counts`` when the snapshot
+  carries buckets but no window percentiles).
+
+Exit codes: 0 on success (even for an empty file — it says so), 2 when
+the file is missing/unreadable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import OrderedDict, defaultdict
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1e3:10.3f}"
+
+
+def _span_table(durations: Dict[str, List[float]]) -> List[str]:
+    lines = [f"{'span':<28}{'count':>7}{'total_ms':>12}{'mean_ms':>11}"
+             f"{'p50_ms':>11}{'p95_ms':>11}{'p99_ms':>11}{'max_ms':>11}"]
+    for name in sorted(durations):
+        d = np.asarray(durations[name], dtype=np.float64)
+        lines.append(
+            f"{name:<28}{d.size:>7}{_fmt_ms(d.sum()):>12}"
+            f"{_fmt_ms(d.mean()):>11}"
+            f"{_fmt_ms(float(np.percentile(d, 50))):>11}"
+            f"{_fmt_ms(float(np.percentile(d, 95))):>11}"
+            f"{_fmt_ms(float(np.percentile(d, 99))):>11}"
+            f"{_fmt_ms(d.max()):>11}")
+    return lines
+
+
+def _walk_snapshot(data: Dict[str, Any], prefix: str = ""
+                   ) -> Dict[str, Dict[str, Any]]:
+    """Flatten nested snapshot sections ('serve' subtrees etc.) into
+    {counters, gauges, histograms, spans} with prefixed metric names."""
+    out: Dict[str, Dict[str, Any]] = defaultdict(OrderedDict)
+    for key, val in (data or {}).items():
+        if key in ("counters", "gauges", "histograms", "spans"):
+            for name, payload in val.items():
+                out[key][prefix + name] = payload
+        elif isinstance(val, dict):
+            for section, items in _walk_snapshot(
+                    val, prefix=f"{prefix}{key}.").items():
+                out[section].update(items)
+    return out
+
+
+def _histogram_percentiles(summ: Dict[str, Any]) -> Dict[str, Any]:
+    """Prefer the snapshot's window-exact percentiles; reconstruct from
+    bucket counts when only those survived (merged/foreign snapshots)."""
+    if summ.get("p50") is not None:
+        return summ
+    buckets = summ.get("buckets") or {}
+    bounds, counts = [], []
+    overflow = 0
+    for bound, n in buckets.items():
+        if bound == "+inf":
+            overflow = int(n)
+        else:
+            bounds.append(float(bound))
+            counts.append(int(n))
+    order = np.argsort(bounds)
+    bounds = [bounds[i] for i in order]
+    counts = [counts[i] for i in order] + [overflow]
+    from ddls_tpu.telemetry import percentile_from_bucket_counts
+
+    out = dict(summ)
+    for q in (50, 95, 99):
+        out[f"p{q}"] = percentile_from_bucket_counts(
+            bounds, counts, q, lo=summ.get("min"), hi=summ.get("max"))
+    return out
+
+
+def render_report(path: str) -> List[str]:
+    span_durations: Dict[str, List[float]] = defaultdict(list)
+    event_counts: Dict[tuple, int] = defaultdict(int)
+    event_last: Dict[tuple, dict] = {}
+    last_snapshot: Dict[str, Any] = {}
+    n_lines = n_bad = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            n_lines += 1
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                n_bad += 1
+                continue
+            kind = rec.get("type")
+            if kind == "span":
+                span_durations[rec.get("name", "?")].append(
+                    float(rec.get("dur_s", 0.0)))
+            elif kind == "event":
+                key = (rec.get("kind", "?"), rec.get("phase"))
+                event_counts[key] += 1
+                event_last[key] = rec
+            elif kind == "snapshot":
+                last_snapshot = rec.get("data") or {}
+
+    lines = [f"telemetry report: {path} ({n_lines} records"
+             + (f", {n_bad} unparseable" if n_bad else "") + ")", ""]
+    if span_durations:
+        lines += ["== spans (from per-span records; exact percentiles) =="]
+        lines += _span_table(span_durations)
+        lines += [""]
+    if event_counts:
+        lines += ["== events ==",
+                  f"{'kind':<24}{'phase':<18}{'count':>7}  last"]
+        for (kind, phase), count in sorted(event_counts.items()):
+            last = {k: v for k, v in event_last[(kind, phase)].items()
+                    if k not in ("type", "kind", "phase", "ts")}
+            lines.append(f"{kind:<24}{str(phase):<18}{count:>7}  "
+                         f"{json.dumps(last)}")
+        lines += [""]
+    if last_snapshot:
+        sections = _walk_snapshot(last_snapshot)
+        if sections.get("counters"):
+            lines += ["== counters (last snapshot) =="]
+            for name, value in sorted(sections["counters"].items()):
+                lines.append(f"{name:<52}{value:>12}")
+            lines += [""]
+        if sections.get("gauges"):
+            lines += ["== gauges (last snapshot) =="]
+            for name, value in sorted(sections["gauges"].items()):
+                lines.append(f"{name:<52}{value:>12}")
+            lines += [""]
+        if sections.get("histograms"):
+            lines += ["== histograms (last snapshot) ==",
+                      f"{'metric':<40}{'count':>8}{'mean':>12}{'p50':>12}"
+                      f"{'p95':>12}{'p99':>12}"]
+            for name, summ in sorted(sections["histograms"].items()):
+                if not summ.get("count"):
+                    continue
+                summ = _histogram_percentiles(summ)
+
+                def cell(v):
+                    return "n/a" if v is None else f"{v:.6g}"
+
+                lines.append(
+                    f"{name:<40}{summ['count']:>8}"
+                    f"{cell(summ.get('mean')):>12}"
+                    f"{cell(summ.get('p50')):>12}"
+                    f"{cell(summ.get('p95')):>12}"
+                    f"{cell(summ.get('p99')):>12}")
+            lines += [""]
+        if sections.get("spans") and not span_durations:
+            lines += ["== spans (last snapshot; windowed percentiles) ==",
+                      f"{'span':<28}{'count':>7}{'total_s':>10}"
+                      f"{'mean_ms':>11}{'p50_ms':>11}{'p99_ms':>11}"]
+            for name, summ in sorted(sections["spans"].items()):
+                lines.append(
+                    f"{name:<28}{summ['count']:>7}"
+                    f"{summ['total_s']:>10.3f}{summ['mean_ms']:>11.3f}"
+                    f"{summ['p50_ms']:>11.3f}{summ['p99_ms']:>11.3f}")
+            lines += [""]
+    if len(lines) == 2:
+        lines.append("(no telemetry records found)")
+    return lines
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Summarize a telemetry JSONL sink file")
+    parser.add_argument("path", help="JSONL file written via "
+                                     "--telemetry-jsonl / "
+                                     "DDLS_TELEMETRY_JSONL")
+    args = parser.parse_args(argv)
+    if not os.path.exists(args.path):
+        print(f"error: no such file: {args.path}", file=sys.stderr)
+        return 2
+    print("\n".join(render_report(args.path)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

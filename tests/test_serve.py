@@ -683,6 +683,22 @@ def test_bench_serve_smoke(capsys):
     assert 0.0 <= payload["fallback_rate"] <= 1.0
     assert payload["num_requests"] == 48
     assert payload["n_compiles"] <= len(payload["buckets"])
+    # ISSUE 3 acceptance: the JSON line carries a telemetry section whose
+    # histogram-derived p50/p99 agree with the existing latency fields
+    # (same trailing window; the top-level fields are rounded to 3 dp)
+    tele = payload["telemetry"]
+    assert "bench.run" in tele["spans"]
+    lat = tele["serve"]["histograms"]["serve.latency_s"]
+    assert lat["count"] == 48
+    assert lat["p50"] * 1e3 == pytest.approx(payload["p50_latency_ms"],
+                                             abs=5e-4)
+    assert lat["p99"] * 1e3 == pytest.approx(payload["p99_latency_ms"],
+                                             abs=5e-4)
+    serve_counters = tele["serve"]["counters"]
+    assert serve_counters["serve.requests"] == 48
+    assert sum(v for k, v in serve_counters.items()
+               if k.startswith("serve.flush_cause.")) == \
+        serve_counters["serve.flushes"]
 
 
 def test_bench_pad_bounds_cache_fingerprints_dataset(tmp_path):

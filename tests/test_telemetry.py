@@ -1,0 +1,397 @@
+"""Unified telemetry layer tests (ISSUE 3): span/histogram math under an
+injected clock, thread-safety, snapshot/reset semantics, the
+disabled-path guard on the env hot loop (no metrics, no per-step
+allocations — by counter), probe-outcome events, the JSONL sink +
+report script, serve stats on telemetry primitives, and the bench
+`telemetry` JSON section (sim mode; serve mode is asserted where the
+serve bench smoke already runs, tests/test_serve.py)."""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from ddls_tpu import telemetry
+
+pytestmark = pytest.mark.telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_telemetry():
+    """Each test starts and ends with the global registry disabled,
+    empty, sinkless, and back on the real clock (telemetry is
+    process-global state; a leaked injected clock would freeze any later
+    `span.elapsed()` loop)."""
+    import time
+
+    def clean():
+        telemetry.reset()
+        telemetry.disable()
+        reg = telemetry.registry()
+        reg.sink = None
+        reg.clock = time.perf_counter
+        reg.jax_trace_dir = None
+        reg.jax_trace_spans = frozenset()
+
+    clean()
+    yield
+    clean()
+
+
+# --------------------------------------------------------------- primitives
+def test_span_math_under_injected_clock():
+    t = {"now": 100.0}
+    reg = telemetry.Registry(enabled=True, clock=lambda: t["now"])
+    with reg.span("phase") as sp:
+        t["now"] += 0.25
+    assert sp.duration_s == 0.25
+    with reg.span("phase") as sp:
+        t["now"] += 0.75
+        assert sp.elapsed() == 0.75  # mid-span running clock
+    s = reg.span_summaries()["phase"]
+    assert s["count"] == 2
+    assert s["total_s"] == pytest.approx(1.0)
+    assert s["mean_ms"] == pytest.approx(500.0)
+    # np.percentile over the window: exact, deterministic
+    assert s["p50_ms"] == pytest.approx(500.0)
+    assert s["max_ms"] == pytest.approx(750.0)
+
+
+def test_histogram_buckets_and_window_percentiles():
+    h = telemetry.Histogram("lat", buckets=(0.001, 0.01, 0.1))
+    samples = (0.0005, 0.005, 0.05, 0.5)
+    for v in samples:
+        h.observe(v)
+    # le-convention fixed buckets + one overflow
+    assert h.bucket_counts() == {"0.001": 1, "0.01": 1, "0.1": 1,
+                                 "+inf": 1}
+    arr = np.asarray(samples, dtype=np.float64)
+    for q in (50, 95, 99):
+        assert h.percentile(q) == float(np.percentile(arr, q))
+    summ = h.summary()
+    assert summ["count"] == 4
+    assert summ["min"] == 0.0005 and summ["max"] == 0.5
+
+
+def test_histogram_bucket_only_percentile_fallback():
+    h = telemetry.Histogram("x", buckets=(1.0, 2.0, 4.0), window=0)
+    for v in [0.5] * 50 + [3.0] * 50:
+        h.observe(v)
+    p50 = h.percentile(50)
+    p99 = h.percentile(99)
+    assert 0.5 <= p50 <= 2.0  # inside the buckets bracketing the median
+    assert 2.0 <= p99 <= 3.0  # clamped to the observed max
+
+
+def test_thread_safe_aggregation():
+    reg = telemetry.Registry(enabled=True)
+    counter = reg.counter("c")
+    hist = reg.histogram("h")
+
+    def work():
+        for i in range(5000):
+            counter.inc()
+            hist.observe(0.001 * (i % 7))
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert counter.value == 8 * 5000
+    assert hist.count == 8 * 5000
+
+
+def test_snapshot_reset_semantics():
+    telemetry.enable()
+    telemetry.inc("a", 3)
+    telemetry.set_gauge("g", 1.5)
+    telemetry.observe("h", 0.01)
+    with telemetry.span("s"):
+        pass
+    snap = telemetry.snapshot()
+    assert snap["counters"]["a"] == 3
+    assert snap["gauges"]["g"] == 1.5
+    assert snap["histograms"]["h"]["count"] == 1
+    assert snap["spans"]["s"]["count"] == 1
+    telemetry.reset()
+    assert telemetry.snapshot() == {}
+    # registry still enabled after reset: new metrics record fresh
+    telemetry.inc("a")
+    assert telemetry.snapshot() == {"counters": {"a": 1}}
+
+
+def test_event_records_counters_by_phase():
+    telemetry.enable()
+    telemetry.record_event("tpu_probe", phase="attempt", timeout_s=1.0)
+    telemetry.record_event("tpu_probe", phase="timeout",
+                           wedge_suspected=True)
+    c = telemetry.snapshot()["counters"]
+    assert c["event.tpu_probe"] == 2
+    assert c["event.tpu_probe.attempt"] == 1
+    assert c["event.tpu_probe.timeout"] == 1
+
+
+# ------------------------------------------------------------ disabled path
+def test_disabled_api_is_near_noop():
+    assert not telemetry.enabled()
+    # the span is a shared singleton: zero allocations per call
+    assert telemetry.span("x") is telemetry.span("y")
+    with telemetry.span("x") as sp:
+        pass
+    assert sp.elapsed() == 0.0 and sp.duration_s == 0.0
+    telemetry.inc("c")
+    telemetry.observe("h", 1.0)
+    telemetry.set_gauge("g", 2.0)
+    telemetry.record_event("k", phase="p")
+    assert telemetry.snapshot() == {}
+
+
+def _tiny_env(dataset_dir):
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+
+    return RampJobPartitioningEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 1000.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.1, "max_val": 1.0, "decimals": 2},
+            "replication_factor": 5,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 50},
+        max_partitions_per_op=8,
+        min_op_run_time_quantum=0.01,
+        reward_function="job_acceptance",
+        reward_function_kwargs={"fail_reward": -1, "success_reward": 1},
+        max_simulation_run_time=2e4,
+        pad_obs_kwargs={"max_nodes": 64, "max_edges": 256})
+
+
+def _step_env(env, n_steps, seed=0):
+    obs = env.reset(seed=seed)
+    rng = np.random.RandomState(seed)
+    for _ in range(n_steps):
+        valid = np.flatnonzero(np.asarray(obs["action_mask"]))
+        obs, _, done, _ = env.step(int(rng.choice(valid)))
+        if done:
+            obs = env.reset(seed=seed)
+    return obs
+
+
+def test_env_hot_loop_disabled_guard(dataset_dir, monkeypatch):
+    """Acceptance guard: with telemetry disabled the env step loop
+    creates NO metrics and performs no per-step telemetry allocations —
+    counted by intercepting every metric-creating registry call."""
+    reg = telemetry.registry()
+    created = {"n": 0}
+    for factory in ("counter", "gauge", "histogram", "span"):
+        orig = getattr(reg, factory)
+
+        def counting(*a, _orig=orig, **k):
+            created["n"] += 1
+            return _orig(*a, **k)
+
+        monkeypatch.setattr(reg, factory, counting)
+
+    env = _tiny_env(dataset_dir)
+    _step_env(env, 6)
+    assert created["n"] == 0
+    assert telemetry.snapshot() == {}
+
+    # flipping the switch makes the SAME loop record cache/backend
+    # counters (lookahead + partition memo instrumentation is live)
+    telemetry.enable()
+    _step_env(env, 6, seed=1)
+    counters = telemetry.snapshot()["counters"]
+    assert any(k.startswith("sim.lookahead_cache.") for k in counters), \
+        counters
+    assert any(k.startswith("sim.partition_cache.") for k in counters)
+    assert any(k.startswith("sim.lookahead.backend.") for k in counters)
+    assert created["n"] > 0
+
+
+# ------------------------------------------------------------- probe events
+def test_probe_outcomes_recorded():
+    import bench
+
+    telemetry.enable()
+    err = bench.probe_backend(timeout=120, force_cpu=True)
+    assert err is None
+    c = telemetry.snapshot()["counters"]
+    assert c["event.tpu_probe.attempt"] == 1
+    assert c["event.tpu_probe.success"] == 1
+    assert "tpu.probe" in telemetry.span_summaries()
+
+
+def test_probe_timeout_marks_wedge_suspected():
+    import bench
+
+    telemetry.enable()
+    err = bench.probe_backend(timeout=0.001, force_cpu=True)
+    assert err is not None and "timed out" in err
+    c = telemetry.snapshot()["counters"]
+    assert c["event.tpu_probe.timeout"] == 1
+    assert c.get("event.tpu_probe.success") is None
+
+
+# ------------------------------------------------------- jax profiler hook
+def test_jax_trace_hook_wraps_configured_span(monkeypatch, tmp_path):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+    reg = telemetry.Registry(enabled=True)
+    reg.jax_trace_dir = str(tmp_path)
+    reg.jax_trace_spans = frozenset({"traced"})
+    with reg.span("untraced"):
+        pass
+    assert calls == []
+    with reg.span("traced"):
+        with reg.span("traced"):  # nested: only the outer owns the trace
+            pass
+        # the inner same-name exit must NOT have stopped the outer trace
+        assert calls == [("start", str(tmp_path))]
+    assert calls == [("start", str(tmp_path)), ("stop", None)]
+    # one capture per process: later occurrences never re-arm the profiler
+    with reg.span("traced"):
+        pass
+    assert calls == [("start", str(tmp_path)), ("stop", None)]
+
+
+# ----------------------------------------------------------- sink + report
+def test_jsonl_sink_and_report_script(tmp_path):
+    sink_path = str(tmp_path / "tel.jsonl")
+    t = {"now": 0.0}
+    telemetry.enable(sink_path=sink_path, clock=lambda: t["now"])
+    for dur in (0.01, 0.02, 0.03):
+        with telemetry.span("train.collect"):
+            t["now"] += dur
+    telemetry.record_event("tpu_probe", phase="success",
+                           round_trip_ms=116.0)
+    telemetry.dump_snapshot(extra={"serve": {"counters": {"x": 1}}})
+    records = [json.loads(line)
+               for line in open(sink_path).read().splitlines()]
+    kinds = [r["type"] for r in records]
+    assert kinds.count("span") == 3
+    assert kinds.count("event") == 1
+    assert kinds[-1] == "snapshot"
+    assert records[-1]["data"]["serve"]["counters"]["x"] == 1
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "telemetry_report.py"), sink_path],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "train.collect" in out.stdout
+    assert "tpu_probe" in out.stdout
+    assert "event.tpu_probe.success" in out.stdout
+
+
+def test_report_script_missing_file():
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "telemetry_report.py"),
+         "/nonexistent/tel.jsonl"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2
+
+
+# ------------------------------------------------------------ check script
+def test_check_no_bare_timers_clean_tree():
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_no_bare_timers.py")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_check_no_bare_timers_flags_new_pair(tmp_path):
+    bad = tmp_path / "hot_module.py"
+    bad.write_text("import time\n"
+                   "t0 = time.perf_counter()\n"
+                   "dt = time.perf_counter() - t0\n")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_no_bare_timers.py"),
+         "--paths", str(tmp_path)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "hot_module.py" in out.stdout
+    assert "telemetry.span" in out.stdout
+
+
+# ----------------------------------------------------- serve stats parity
+def test_serve_stats_histogram_agrees_with_exact_percentiles():
+    from ddls_tpu.serve.server import ServeResponse, ServeStats
+
+    stats = ServeStats()
+    rng = np.random.RandomState(0)
+    lats = rng.uniform(1e-4, 5e-2, size=200)
+    for i, lat in enumerate(lats):
+        stats.record_response(ServeResponse(
+            request_id=i, action=8,
+            source="policy" if i % 3 else "fallback",
+            reason="batched" if i % 3 else "saturated",
+            bucket_idx=0, latency_s=float(lat)))
+    for i in range(10):
+        stats.record_flush(fill=(i % 4) + 1, capacity=4,
+                           bucket_idx=i % 2,
+                           cause="fill" if i % 2 else "deadline")
+    s = stats.summary()
+    # histogram-derived percentiles == exact np.percentile of the samples
+    assert s["p50_latency_ms"] == pytest.approx(
+        float(np.percentile(lats, 50)) * 1e3)
+    assert s["p99_latency_ms"] == pytest.approx(
+        float(np.percentile(lats, 99)) * 1e3)
+    assert s["n_requests"] == 0  # record_request not called here
+    assert s["n_policy"] + s["n_fallback"] == 200
+    assert s["flush_causes"] == {"fill": 5, "deadline": 5}
+    occ = stats.per_bucket_occupancy()
+    assert set(occ) == {0, 1} and all(0 < v <= 1 for v in occ.values())
+    # two ServeStats never share counters (private registries)
+    other = ServeStats()
+    assert other.n_fallback == 0 and other.summary()["n_flushes"] == 0
+    # registry snapshot is the bench/report surface
+    snap = stats.registry.snapshot()
+    assert snap["histograms"]["serve.latency_s"]["count"] == 200
+
+
+# ------------------------------------------------------------- bench section
+def test_bench_sim_mode_emits_telemetry_section(capsys):
+    import bench
+
+    rc = bench.main(["--mode", "sim", "--sim-seconds", "0.5",
+                     "--num-envs", "2"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert rc == 0, payload
+    tele = payload["telemetry"]
+    assert "bench.warmup" in tele["spans"]
+    assert "bench.run" in tele["spans"]
+    # the run span IS the measurement window: value = steps / duration
+    assert tele["spans"]["bench.run"]["total_s"] >= 0.5
+    # sim cache counters crossed the env-worker process boundary
+    counters = tele.get("counters", {})
+    assert any(k.startswith("sim.lookahead_cache.") for k in counters), \
+        counters

@@ -143,7 +143,22 @@ def test_rl_epoch_loop_end_to_end(dataset_dir, tmp_path):
     r1 = loop.run()
     assert r1["env_steps_this_iter"] == 8
     assert np.isfinite(r1["learner"]["total_loss"])
-    r2 = loop.run()
+    # per-update phase spans land in the global telemetry registry when
+    # enabled (ISSUE 3) — and stay absent while it is disabled (r1 above)
+    from ddls_tpu import telemetry
+
+    assert "train.collect" not in telemetry.span_summaries()
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        r2 = loop.run()
+        spans = telemetry.span_summaries()
+        assert {"train.collect", "train.device_transfer",
+                "train.train_step", "train.host_sync"} <= set(spans)
+        assert all(s["count"] == 1 for s in spans.values())
+    finally:
+        telemetry.reset()
+        telemetry.disable()
     assert r2["total_env_steps"] == 16
 
     # greedy evaluation produces cluster stats
