@@ -233,7 +233,13 @@ class ApexDQNLearner:
         self.tx = optax.chain(*chain)
 
         self._replicated = replicated_sharding(mesh)
-        self._jit_train_step = jax.jit(self._train_step, donate_argnums=(0,))
+        # state donated on accelerators only — on CPU donation forces the
+        # jitted call to execute inline on the dispatching thread
+        # (ppo.traj_donate_argnums), defeating async dispatch
+        from ddls_tpu.rl.ppo import traj_donate_argnums
+
+        self._jit_train_step = jax.jit(
+            self._train_step, donate_argnums=traj_donate_argnums(0))
         self._jit_sample = jax.jit(self._sample_actions)
 
     # ------------------------------------------------------------- state

@@ -89,7 +89,12 @@ class ESLearner:
 
         self._jit_perturb = jax.jit(self._perturb)
         self._jit_pop_actions = jax.jit(self._pop_actions)
-        self._jit_update = jax.jit(self._update, donate_argnums=(0,))
+        # state donated on accelerators only (see ppo.traj_donate_argnums:
+        # CPU donation forces inline execution of the jitted call)
+        from ddls_tpu.rl.ppo import traj_donate_argnums
+
+        self._jit_update = jax.jit(self._update,
+                                   donate_argnums=traj_donate_argnums(0))
 
     def init_state(self, params) -> ESState:
         params = jax.tree_util.tree_map(jnp.copy, params)

@@ -77,12 +77,17 @@ class PGLearner:
         self._replicated = replicated_sharding(mesh)
         self._batch_time = NamedSharding(mesh, P(None, "dp"))
         self._batch_only = NamedSharding(mesh, P("dp"))
+        # traj/last_values donated too on accelerator backends (see
+        # ppo.traj_donate_argnums): the staged batch is single-use, so
+        # its buffers need not outlive the update
+        from ddls_tpu.rl.ppo import traj_donate_argnums
+
         self._jit_train_step = jax.jit(
             self._train_step,
             in_shardings=(self._replicated, self._batch_time,
                           self._batch_only),
             out_shardings=(self._replicated, self._replicated),
-            donate_argnums=(0,))
+            donate_argnums=traj_donate_argnums(0, 1, 2))
         self._jit_sample = jax.jit(self._sample_actions)
 
     def init_state(self, params) -> PGState:

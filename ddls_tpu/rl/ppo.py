@@ -32,6 +32,30 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ddls_tpu.parallel.mesh import replicated_sharding, shard_batch
 
 
+def traj_donate_argnums(state_argnum: int, *traj_argnums: int):
+    """Donation plan for a jitted train step: on accelerator backends the
+    state AND the staged trajectory/last_values buffers (shard_traj's
+    device_put) are donated — the batch is consumed exactly once, so the
+    staging copy of the largest arrays in the loop (the [T, B, ...] obs)
+    disappears instead of outliving the update, and the state updates in
+    place. Callers must treat shard_traj output as moved-from after
+    train_step there.
+
+    On CPU donation is DISABLED entirely (round 6, measured in
+    docs/perf_round6.md): XLA:CPU cannot alias the staged batch into the
+    update's outputs anyway ('donated buffers were not usable'), and —
+    the load-bearing part — a donated jitted call EXECUTES INLINE on the
+    dispatching thread instead of dispatching asynchronously, which
+    serialises the update against all host work and defeats the
+    pipelined loop's overlap. Bit-identical numerics either way.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return ()
+    return (state_argnum,) + tuple(traj_argnums)
+
+
 @dataclasses.dataclass
 class PPOConfig:
     lr: float = 2.785e-4
@@ -204,7 +228,7 @@ class PPOLearner:
                 in_shardings=(shardings, self._batch_time,
                               self._batch_only, self._replicated),
                 out_shardings=(shardings, self._replicated),
-                donate_argnums=(0,))
+                donate_argnums=traj_donate_argnums(0, 1, 2))
         self._jit_train_step = self._jit_cache[key]
         return jax.device_put(state, shardings)
 

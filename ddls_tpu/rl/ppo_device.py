@@ -38,6 +38,7 @@ class DevicePPOCollector:
         import jax
         import jax.numpy as jnp
 
+        from ddls_tpu.rl.ppo import traj_donate_argnums
         from ddls_tpu.sim.jax_env import make_segment_fn, segment_init
 
         self.et, self.ot, self.model = et, ot, model
@@ -56,14 +57,21 @@ class DevicePPOCollector:
             repl = NamedSharding(mesh, P())
             banks = jax.device_put(banks, lane)
             # rngs/state arrive as host (or mismatched) arrays; jit's
-            # explicit in_shardings reshards them on dispatch
+            # explicit in_shardings reshards them on dispatch. The env
+            # state (argnum 2) is donated on accelerator backends: each
+            # collect replaces it with the returned state, so the old
+            # buffers can back the new ones in place instead of doubling
+            # the per-lane sim state (CPU donation disabled — it forces
+            # inline execution of the jitted call, ppo.traj_donate_argnums)
             self._vseg = jax.jit(
                 jax.vmap(segment, in_axes=(0, None, 0, 0)),
                 in_shardings=(lane, repl, lane, lane),
-                out_shardings=(lane, lane, lane))
+                out_shardings=(lane, lane, lane),
+                donate_argnums=traj_donate_argnums(2))
         else:
             self._vseg = jax.jit(jax.vmap(segment,
-                                          in_axes=(0, None, 0, 0)))
+                                          in_axes=(0, None, 0, 0)),
+                                 donate_argnums=traj_donate_argnums(2))
         self.banks = banks
         # per-env initial state from each env's OWN bank (arrival clocks
         # differ across banks)

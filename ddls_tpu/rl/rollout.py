@@ -68,6 +68,12 @@ class VectorEnv:
         self.episode_lengths = np.zeros(self.num_envs, dtype=np.int64)
         self.completed_episodes: List[Dict[str, Any]] = []
 
+    def stacked_obs(self) -> Dict[str, np.ndarray]:
+        """The current obs list as one [B, ...] batch (in-process envs
+        have no stepping to overlap the stacking with — see
+        ParallelVectorEnv.stacked_obs for the prefetched variant)."""
+        return stack_obs(self.obs)
+
     def reset(self) -> List[Dict[str, np.ndarray]]:
         self.obs = [env.reset(seed=self.seeds[i])
                     for i, env in enumerate(self.envs)]
@@ -205,6 +211,15 @@ class ParallelVectorEnv:
                  start_method: str = "spawn"):
         self.num_envs = num_envs
         self.seeds = seeds or list(range(num_envs))
+        # opt-in (the pipelined collector sets it): full-batch step()
+        # receives worker replies OUT OF ORDER as they finish and writes
+        # each obs row straight into a stacked [B, ...] batch, so the
+        # next sample's input assembles while slower workers still step
+        # — the stacking cost rides inside the env wall instead of after
+        # it. Off by default so the sequential loop keeps the seed's
+        # exact cost profile for load-controlled comparisons.
+        self.prefetch_stacked = False
+        self._stacked_cache: Optional[Dict[str, np.ndarray]] = None
         ctx = mp.get_context(start_method)
         self._conns = []
         self._procs = []
@@ -235,17 +250,71 @@ class ParallelVectorEnv:
         # first reset pins them, later resets continue each worker's sequence
         payload = self.seeds if self._first_reset else [None] * self.num_envs
         self._first_reset = False
+        self._stacked_cache = None
         for conn, seed in zip(self._conns, payload):
             conn.send(("reset", seed))
         self.obs = [self._recv(conn)[1] for conn in self._conns]
         return self.obs
 
+    def stacked_obs(self) -> Dict[str, np.ndarray]:
+        """The current obs as one [B, ...] batch; with
+        ``prefetch_stacked`` the batch was already assembled inside the
+        previous ``step()`` as worker replies arrived (bit-identical to
+        ``stack_obs(self.obs)``, measured earlier)."""
+        if self._stacked_cache is not None:
+            return self._stacked_cache
+        return stack_obs(self.obs)
+
     def step(self, actions: np.ndarray):
+        if self.prefetch_stacked:
+            return self._step_prefetch(actions)
         return self.step_subset(range(self.num_envs), actions)
+
+    def _step_prefetch(self, actions: np.ndarray):
+        """Full-batch step with out-of-order reply handling: each worker's
+        obs row lands in a fresh stacked batch the moment it arrives, so
+        stacking overlaps the stragglers' env stepping. Outputs (obs,
+        rewards, dones, episode-record order) are bit-identical to the
+        in-order path — records are flushed in env-index order."""
+        from multiprocessing import connection as mp_connection
+
+        for i, conn in enumerate(self._conns):
+            conn.send(("step", int(actions[i])))
+        B = self.num_envs
+        rewards = np.zeros(B, dtype=np.float32)
+        dones = np.zeros(B, dtype=bool)
+        stacked: Optional[Dict[str, np.ndarray]] = None
+        records: Dict[int, dict] = {}
+        remaining = {conn: i for i, conn in enumerate(self._conns)}
+        while remaining:
+            for conn in mp_connection.wait(list(remaining)):
+                i = remaining.pop(conn)
+                kind, payload = conn.recv()
+                if kind == "error":
+                    self.close()
+                    raise RuntimeError(f"env worker failed:\n{payload}")
+                obs, reward, done, record = payload
+                self.obs[i] = obs
+                if stacked is None:
+                    stacked = {
+                        k: np.empty((B,) + np.asarray(obs[k]).shape,
+                                    np.asarray(obs[k]).dtype)
+                        for k in OBS_KEYS}
+                for k in OBS_KEYS:
+                    stacked[k][i] = obs[k]
+                rewards[i] = reward
+                dones[i] = done
+                if record is not None:
+                    records[i] = record
+        self.completed_episodes.extend(
+            records[i] for i in sorted(records))
+        self._stacked_cache = stacked
+        return list(self.obs), rewards, dones
 
     def step_subset(self, indices, actions: np.ndarray):
         """Step only the workers in ``indices``; see VectorEnv.step_subset."""
         indices = list(indices)
+        self._stacked_cache = None
         for k, i in enumerate(indices):
             self._conns[i].send(("step", int(actions[k])))
         rewards = np.zeros(len(indices), dtype=np.float32)
@@ -268,6 +337,7 @@ class ParallelVectorEnv:
         on the dedicated restart command and drop partial accumulators."""
         if self._first_reset:
             return self.reset()
+        self._stacked_cache = None
         for conn in self._conns:
             conn.send(("restart", None))
         self.obs = [self._recv(conn)[1] for conn in self._conns]
@@ -318,11 +388,40 @@ class RolloutCollector:
     """
 
     def __init__(self, vec_env: VectorEnv, learner, rollout_length: int,
-                 pipeline: Optional[bool] = None):
+                 pipeline: Optional[bool] = None,
+                 deferred_fetch: bool = False):
         self.vec_env = vec_env
         self.learner = learner
         self.rollout_length = rollout_length
         B = vec_env.num_envs
+        # deferred_fetch (the pipelined loop mode, train/loops.py): one
+        # jitted program per step (rng split folded in), actions are the
+        # ONLY per-step device fetch (logp/values stay device futures,
+        # drained in one device_get at segment end), obs rows are copied
+        # into preallocated [T, B, ...] traj buffers while the forward
+        # is in flight, and every transfer is explicit
+        # (device_put/device_get — pinned by the transfer-guard test).
+        # Bit-identical outputs to the plain path; only the
+        # dispatch/fetch schedule changes.
+        self.deferred_fetch = bool(deferred_fetch)
+        self._jit_step_fn = None
+        # explicit staging target for the stacked obs: the learner's
+        # replicated mesh sharding (where its params live), so the jitted
+        # sample needs no implicit device-to-device reshard — a bare
+        # device_put would commit to ONE device and trip the
+        # transfer-guard pin (and a real reshard) on multi-device meshes.
+        # MULTI-PROCESS: never — each process's obs are ITS OWN shard of
+        # the collection, and a device_put onto the global mesh would
+        # fabricate a "replicated" global array from process-divergent
+        # data (mismatched collectives downstream: gloo size errors).
+        # There the batch rides into the jit as host arrays, exactly as
+        # the pre-round-6 collector did.
+        self._obs_sharding = (getattr(learner, "_replicated", None)
+                              if jax.process_count() == 1 else None)
+        if self.deferred_fetch:
+            pipeline = False  # deferred path has its own schedule
+            if getattr(vec_env, "prefetch_stacked", None) is False:
+                vec_env.prefetch_stacked = True
         if pipeline is None and (B < 2 or B % 2
                                  or jax.default_backend() == "cpu"):
             # overlap only exists when sampling runs on an accelerator; on a
@@ -337,6 +436,78 @@ class RolloutCollector:
         self.pipeline = pipeline
         self._needs_reset = True
 
+    def _step_program(self):
+        """One jitted program per rollout step: rng split + sampling fused,
+        so the host dispatches once instead of paying a separate
+        ~ms-scale ``jax.random.split`` dispatch per step. The split tree
+        is IDENTICAL to the plain path's host-side
+        ``rng, step_rng = split(rng)`` followed by sampling with
+        ``step_rng`` — same bits out."""
+        if self._jit_step_fn is None:
+            sample = self.learner._sample_actions
+
+            def step_fn(params, obs, rng):
+                rng, step_rng = jax.random.split(rng)
+                actions, logp, values = sample(params, obs, step_rng)
+                return rng, actions, logp, values
+
+            self._jit_step_fn = jax.jit(step_fn)
+        return self._jit_step_fn
+
+    def _collect_deferred(self, params, rng) -> Dict[str, Any]:
+        """Deferred-fetch collection (see __init__); [T, B] outputs
+        bit-identical to the plain path."""
+        T, B = self.rollout_length, self.vec_env.num_envs
+        step_fn = self._step_program()
+        if self._obs_sharding is not None:
+            # the epoch's incoming key was split outside the mesh; place
+            # it next to the params explicitly (after step 0 the key is
+            # step_fn's own replicated output and stays put)
+            rng = jax.device_put(rng, self._obs_sharding)
+        act_buf = np.zeros((T, B), dtype=np.int32)
+        rew_buf = np.zeros((T, B), dtype=np.float32)
+        done_buf = np.zeros((T, B), dtype=bool)
+        traj_obs: Optional[Dict[str, np.ndarray]] = None
+        logp_refs: List[Any] = [None] * T
+        val_refs: List[Any] = [None] * T
+        for t in range(T):
+            batched = self.vec_env.stacked_obs()
+            staged = (jax.device_put(batched, self._obs_sharding)
+                      if self._obs_sharding is not None else batched)
+            rng, actions, logp, values = step_fn(params, staged, rng)
+            if traj_obs is None:
+                traj_obs = {k: np.empty((T,) + batched[k].shape,
+                                        batched[k].dtype)
+                            for k in OBS_KEYS}
+            # the copy into the traj buffers runs while the device is
+            # still computing this step's forward
+            for k in OBS_KEYS:
+                traj_obs[k][t] = batched[k]
+            actions = jax.device_get(actions)
+            act_buf[t] = actions
+            logp_refs[t] = logp
+            val_refs[t] = values
+            _, rewards, dones = self.vec_env.step(actions)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+        final = self.vec_env.stacked_obs()
+        final_staged = (jax.device_put(final, self._obs_sharding)
+                        if self._obs_sharding is not None else final)
+        rng, _, _, last_values = step_fn(params, final_staged, rng)
+        # ONE drain for every deferred future (all long since ready —
+        # this is a batch of buffer copies, not a wait)
+        logp_host, val_host, last_host = jax.device_get(
+            (logp_refs, val_refs, last_values))
+        return {
+            "traj": {"obs": traj_obs, "actions": act_buf,
+                     "logp": np.stack(logp_host).astype(np.float32),
+                     "values": np.stack(val_host).astype(np.float32),
+                     "rewards": rew_buf, "dones": done_buf},
+            "last_values": np.asarray(last_host, np.float32),
+            "episodes": self.vec_env.drain_completed_episodes(),
+            "env_steps": T * B,
+        }
+
     def collect(self, params, rng) -> Dict[str, Any]:
         """Run rollout_length steps in every env; returns a trajectory dict
         of [T, B, ...] host arrays plus bootstrap values [B]."""
@@ -344,6 +515,8 @@ class RolloutCollector:
         if self._needs_reset:
             self.vec_env.reset()
             self._needs_reset = False
+        if self.deferred_fetch:
+            return self._collect_deferred(params, rng)
         if self.pipeline and B >= 2 and B % 2 == 0:
             return self._collect_pipelined(params, rng)
 

@@ -40,17 +40,17 @@ from typing import Any, Dict, Optional, Sequence
 from ddls_tpu.telemetry.metrics import (DEFAULT_LATENCY_BUCKETS_S,
                                         DEFAULT_WINDOW, NULL_SPAN, Counter,
                                         Gauge, Histogram, NullSpan,
-                                        Registry, Span,
+                                        Registry, Span, overlap_summary,
                                         percentile_from_bucket_counts)
 from ddls_tpu.telemetry.sink import JsonlSink
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span", "NullSpan",
     "NULL_SPAN", "JsonlSink", "DEFAULT_LATENCY_BUCKETS_S",
-    "DEFAULT_WINDOW", "percentile_from_bucket_counts",
+    "DEFAULT_WINDOW", "percentile_from_bucket_counts", "overlap_summary",
     "registry", "enabled", "enable", "disable", "span", "inc", "observe",
     "set_gauge", "record_event", "snapshot", "span_summaries", "reset",
-    "dump_snapshot",
+    "dump_snapshot", "clock_now", "record_span", "span_intervals",
 ]
 
 _GLOBAL = Registry(enabled=False)
@@ -75,11 +75,14 @@ def enabled() -> bool:
 def enable(sink_path: Optional[str] = None,
            clock=None,
            jax_trace_dir: Optional[str] = None,
-           jax_trace_spans: Sequence[str] = ()) -> Registry:
+           jax_trace_spans: Sequence[str] = (),
+           record_intervals: Optional[bool] = None) -> Registry:
     """Turn the global registry on (idempotent; existing metrics are
     kept — call ``reset()`` first for a fresh measurement window).
     ``sink_path`` attaches a JSONL sink; ``jax_trace_dir`` +
-    ``jax_trace_spans`` arm the opt-in jax.profiler capture."""
+    ``jax_trace_spans`` arm the opt-in jax.profiler capture;
+    ``record_intervals=True`` keeps per-span (start, end) pairs in a
+    bounded ring for ``overlap_summary`` concurrency accounting."""
     if sink_path:
         _GLOBAL.sink = JsonlSink(sink_path)
     if clock is not None:
@@ -89,6 +92,8 @@ def enable(sink_path: Optional[str] = None,
         _GLOBAL._jax_trace_done = False  # arm a fresh one-shot capture
     if jax_trace_spans:
         _GLOBAL.jax_trace_spans = frozenset(jax_trace_spans)
+    if record_intervals is not None:
+        _GLOBAL.record_intervals = bool(record_intervals)
     _GLOBAL.enabled = True
     return _GLOBAL
 
@@ -131,6 +136,20 @@ def record_event(kind: str, **fields) -> None:
         _GLOBAL.event(kind, **fields)
 
 
+def clock_now() -> float:
+    """The registry clock's current reading — the t0 source for
+    ``record_span`` (injectable-clock discipline: never pair a raw
+    wall-clock read with a registry-recorded end)."""
+    return _GLOBAL.clock()
+
+
+def record_span(name: str, t0: float, t1: Optional[float] = None) -> None:
+    """Record an explicitly-timed span (see ``Registry.record_span``);
+    no-op while disabled, like the context-manager form."""
+    if _GLOBAL.enabled:
+        _GLOBAL.record_span(name, t0, t1)
+
+
 # --------------------------------------------------------------- readbacks
 def snapshot() -> Dict[str, Any]:
     return _GLOBAL.snapshot()
@@ -138,6 +157,10 @@ def snapshot() -> Dict[str, Any]:
 
 def span_summaries() -> Dict[str, Dict[str, float]]:
     return _GLOBAL.span_summaries()
+
+
+def span_intervals() -> list:
+    return _GLOBAL.span_intervals()
 
 
 def reset() -> None:

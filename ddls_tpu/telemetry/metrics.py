@@ -297,7 +297,7 @@ class Span:
     def __exit__(self, *exc) -> bool:
         reg = self._registry
         self.duration_s = reg.clock() - self._t0
-        reg._record_span(self.name, self.duration_s)
+        reg._record_span(self.name, self.duration_s, t0=self._t0)
         if self._owns_jax_trace:
             try:
                 import jax
@@ -312,6 +312,12 @@ class Span:
 
     def elapsed(self) -> float:
         return self._registry.clock() - self._t0
+
+
+# bounded span-interval ring: overlap accounting needs (start, end) pairs,
+# which the duration histograms deliberately do not keep; the ring caps the
+# cost of leaving interval recording on for a long run
+DEFAULT_INTERVAL_RING = 65536
 
 
 class Registry:
@@ -334,6 +340,12 @@ class Registry:
         self.jax_trace_spans: frozenset = frozenset()
         self._jax_tracing: Optional[str] = None
         self._jax_trace_done = False  # one capture per process/registry
+        # opt-in (enable(record_intervals=True)): keep (name, t0, t1) for
+        # every completed span so overlap/gap accounting can PROVE claimed
+        # concurrency (e.g. train.update_device running under
+        # train.collect) instead of asserting it
+        self.record_intervals = False
+        self._intervals: deque = deque(maxlen=DEFAULT_INTERVAL_RING)
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
@@ -398,16 +410,37 @@ class Registry:
     def span(self, name: str) -> Span:
         return Span(self, name)
 
-    def _record_span(self, name: str, duration_s: float) -> None:
+    def _record_span(self, name: str, duration_s: float,
+                     t0: Optional[float] = None) -> None:
         with self._lock:
             h = self._spans.get(name)
             if h is None:
                 h = self._spans[name] = Histogram(name)
         h.observe(duration_s)
+        if self.record_intervals and t0 is not None:
+            # deque.append is itself thread-safe; bounded by maxlen
+            self._intervals.append((name, t0, t0 + duration_s))
         sink = self.sink
         if sink is not None:
             sink.write({"type": "span", "name": name,
                         "dur_s": duration_s})
+
+    def record_span(self, name: str, t0: float,
+                    t1: Optional[float] = None) -> None:
+        """Record an explicitly-timed span (same histogram/sink/interval
+        plumbing as the context manager). For work whose start and end
+        live on different threads — e.g. the pipelined train loop's
+        device-update watcher, which captures t0 at dispatch on the main
+        thread and closes the span from the thread that blocked on the
+        device result."""
+        if t1 is None:
+            t1 = self.clock()
+        self._record_span(name, t1 - t0, t0=t0)
+
+    def span_intervals(self) -> list:
+        """Copy of the recorded (name, t0, t1) interval ring (empty unless
+        ``record_intervals`` was set); feed to ``overlap_summary``."""
+        return list(self._intervals)
 
     def span_summaries(self) -> Dict[str, Dict[str, float]]:
         """Per-span rollup in the units humans read spans in (ms), the
@@ -475,6 +508,7 @@ class Registry:
             self._gauges = {}
             self._histograms = {}
             self._spans = {}
+            self._intervals = deque(maxlen=DEFAULT_INTERVAL_RING)
 
     def dump_snapshot(self, extra: Optional[Dict[str, Any]] = None) -> None:
         """Write the current snapshot to the sink (no-op without one)."""
@@ -484,3 +518,63 @@ class Registry:
             if extra:
                 data = {**data, **extra}
             sink.write({"type": "snapshot", "data": data})
+
+
+def overlap_summary(intervals: Sequence[Tuple[str, float, float]],
+                    prefix: Optional[str] = None,
+                    top_gaps: int = 3) -> Dict[str, Any]:
+    """Concurrency accounting over span (name, t0, t1) intervals.
+
+    The check Podracer-style pipelining claims need: over the window
+    [min t0, max t1] of the (optionally ``prefix``-filtered) spans,
+    report the wall-clock covered by >= 1 span (``covered_1_s``), by
+    >= 2 concurrent spans (``covered_2_s`` — time when two instrumented
+    phases genuinely ran at once), the uncovered gap total, and the
+    ``top_gaps`` largest individual gaps. ``overlap_fraction`` =
+    covered_2 / covered_1: 0 for a strictly sequential loop, > 0 only
+    when phases actually overlap. Sources: a Registry's interval ring
+    (``enable(record_intervals=True)``) or a JSONL sink's span records
+    via ``(ts - dur_s, ts)`` (scripts/telemetry_report.py).
+    """
+    ivs = [(t0, t1) for name, t0, t1 in intervals
+           if t1 > t0 and (prefix is None or name.startswith(prefix))]
+    if not ivs:
+        return {"n_spans": 0}
+    events = []
+    for t0, t1 in ivs:
+        events.append((t0, 1))
+        events.append((t1, -1))
+    events.sort()
+    window_t0, window_t1 = events[0][0], max(t1 for _, t1 in ivs)
+    covered_1 = covered_2 = 0.0
+    gaps = []  # (length, start, end) of zero-coverage stretches
+    depth = 0
+    prev_t = window_t0
+    gap_start = None
+    for t, delta in events:
+        if t > prev_t:
+            if depth >= 1:
+                covered_1 += t - prev_t
+            if depth >= 2:
+                covered_2 += t - prev_t
+        if depth == 0 and delta > 0 and gap_start is not None:
+            if t > gap_start:
+                gaps.append((t - gap_start, gap_start, t))
+            gap_start = None
+        prev_t = t
+        depth += delta
+        if depth == 0:
+            gap_start = t
+    gaps.sort(reverse=True)
+    wall = window_t1 - window_t0
+    return {
+        "n_spans": len(ivs),
+        "window_s": wall,
+        "covered_1_s": covered_1,
+        "covered_2_s": covered_2,
+        "gap_s": max(wall - covered_1, 0.0),
+        "overlap_fraction": (covered_2 / covered_1) if covered_1 else 0.0,
+        "largest_gaps": [
+            {"dur_s": g, "start": s, "end": e}
+            for g, s, e in gaps[:max(top_gaps, 0)]],
+    }

@@ -48,7 +48,12 @@ class Logger:
 
     # -------------------------------------------------------------- logging
     def log(self, results: Dict[str, Any]) -> None:
-        """Merge one round of results (lists extend, scalars overwrite)."""
+        """Merge one round of results (lists extend, scalars overwrite).
+
+        Results may carry ``LazyMetrics`` futures (pipelined epoch loop):
+        the merge keeps them as-is — no device traffic on the logging
+        call — and they are materialised on the background save thread
+        (``_save_data``), i.e. off the epoch critical path."""
         self.results = _merge_log(self.results, results)
 
     def save(self, name: str = "results", blocking: bool = False) -> None:
@@ -75,6 +80,14 @@ class Logger:
 
     # ------------------------------------------------------------ backends
     def _save_data(self, name: str, results: Dict[str, Any]) -> None:
+        # lazy-metric sync boundary: replace device futures with plain
+        # float dicts before pickling. This runs on the save thread, so
+        # the device_get it implies never blocks the training loop; a
+        # LazyMetrics materialised here also materialises the SAME object
+        # referenced by any still-held results dict (idempotent fetch).
+        from ddls_tpu.train.metrics import materialize_results
+
+        results = materialize_results(results)
         if self.use_sqlite_database:
             db = SqliteDict(str(Path(self.path_to_save) / f"{name}.sqlite"))
             try:

@@ -144,16 +144,23 @@ def build_policy_from_model_config(n_actions: int,
 
 
 def _episode_summary(episodes: List[dict]) -> Dict[str, float]:
+    # scalar coercions go through the lazy-materialisation helper's
+    # as_float: episode records are host state by contract (never device
+    # fetches on the per-update path), and routing the coercion through
+    # one place keeps it that way if a collector ever slips a device
+    # scalar into a record
+    from ddls_tpu.train.metrics import as_float
+
     if not episodes:
         return {}
     out: Dict[str, float] = {
-        "episode_reward_mean": float(np.mean(
+        "episode_reward_mean": as_float(np.mean(
             [e["episode_return"] for e in episodes])),
-        "episode_reward_min": float(np.min(
+        "episode_reward_min": as_float(np.min(
             [e["episode_return"] for e in episodes])),
-        "episode_reward_max": float(np.max(
+        "episode_reward_max": as_float(np.max(
             [e["episode_return"] for e in episodes])),
-        "episode_len_mean": float(np.mean(
+        "episode_len_mean": as_float(np.mean(
             [e["episode_length"] for e in episodes])),
         "episodes_this_iter": len(episodes),
     }
@@ -164,7 +171,7 @@ def _episode_summary(episodes: List[dict]) -> Dict[str, float]:
                 "mean_job_completion_time_speedup"):
         vals = [e[key] for e in episodes if key in e]
         if vals:
-            out[f"custom_metrics/{key}_mean"] = float(np.mean(vals))
+            out[f"custom_metrics/{key}_mean"] = as_float(np.mean(vals))
     return out
 
 
@@ -178,7 +185,31 @@ class RLEpochLoop:
     * ``rollout_length`` — steps per env per epoch (derived from
       train_batch_size when omitted);
     * ``n_devices`` — mesh size for the dp axis (defaults to all devices).
+
+    Pipelining (docs/perf_round6.md):
+
+    * ``loop_mode="pipelined"`` (default) keeps the hot collect→update
+      path free of blocking device→host transfers: learner metrics stay
+      on device as futures (``LazyMetrics``) and are drained in ONE
+      batched fetch at a sync boundary (every ``metrics_sync_interval``
+      epochs, an eval epoch, or first scalar access); collection uses
+      the deferred-fetch collector (one fused dispatch per step, actions
+      the only per-step fetch). ``"sequential"`` reproduces the pre-
+      pipelining loop exactly: per-update ``float(device_get(metrics))``
+      under ``train.host_sync``. The two modes are bit-identical in
+      params/metrics/episodes (pinned in tests/test_train_pipeline.py);
+      only the dispatch/sync schedule differs.
+    * ``pipeline_depth=1`` (opt-in, off-policy-tolerant learners only —
+      IMPALA, whose V-trace correction exists precisely for this lag)
+      additionally collects epoch n+1 on a background thread against the
+      params from before epoch n's update, so host env stepping overlaps
+      the device update. Learners whose update assumes fresher data
+      (ppo/pg/dqn/es) reject ``pipeline_depth > 0`` loudly.
     """
+
+    # pipeline_depth > 0 staleness is only sound for learners with an
+    # explicit off-policy correction; subclasses opt in (ImpalaEpochLoop)
+    SUPPORTS_STALE_COLLECTION = False
 
     def __init__(self,
                  path_to_env_cls: str,
@@ -197,6 +228,9 @@ class RLEpochLoop:
                  seed: Optional[int] = 0,
                  test_seed: Optional[int] = None,
                  wandb=None,
+                 loop_mode: str = "pipelined",
+                 metrics_sync_interval: int = 10,
+                 pipeline_depth: int = 0,
                  path_to_model_cls: Optional[str] = None,  # config parity
                  **kwargs):
         import jax
@@ -214,6 +248,33 @@ class RLEpochLoop:
         self.wandb = wandb
         self.seed = 0 if seed is None else int(seed)
         self.test_seed = test_seed
+
+        if loop_mode not in ("sequential", "pipelined"):
+            raise ValueError(
+                f"loop_mode must be 'sequential' or 'pipelined', got "
+                f"{loop_mode!r}")
+        self.loop_mode = loop_mode
+        self.metrics_sync_interval = max(int(metrics_sync_interval or 1), 1)
+        self.pipeline_depth = int(pipeline_depth or 0)
+        if self.pipeline_depth < 0 or self.pipeline_depth > 1:
+            raise ValueError(
+                f"pipeline_depth must be 0 or 1, got {pipeline_depth}")
+        if self.pipeline_depth and not self.SUPPORTS_STALE_COLLECTION:
+            raise ValueError(
+                f"{type(self).__name__} does not support pipeline_depth > "
+                "0: collecting against stale params needs an explicit "
+                "off-policy correction (IMPALA's V-trace); ppo/pg/dqn/es "
+                "must collect with the current params (pipeline_depth=0)")
+        if self.pipeline_depth and self.loop_mode != "pipelined":
+            raise ValueError(
+                "pipeline_depth > 0 requires loop_mode='pipelined'")
+        # pipelining runtime state: the prefetched (out, straj, slv)
+        # future, the unsynced-metrics ring, and the lazily-created
+        # executors (collection thread / device-update watcher)
+        self._collect_future = None
+        self._collect_executor = None
+        self._watch_executor = None
+        self._metrics_ring: List[Any] = []
 
         self._configure_algo(algo_config, num_envs, rollout_length)
         # collection backend: host vectorised envs (default) or the
@@ -324,8 +385,9 @@ class RLEpochLoop:
         if getattr(self, "device_collector", False):
             self.collector = self._make_device_collector()
             return
-        self.collector = RolloutCollector(self.vec_env, self.learner,
-                                          self.rollout_length)
+        self.collector = RolloutCollector(
+            self.vec_env, self.learner, self.rollout_length,
+            deferred_fetch=(self.loop_mode == "pipelined"))
         self.collector._needs_reset = False  # env already reset in __init__
 
     def _make_device_collector(self):
@@ -423,6 +485,18 @@ class RLEpochLoop:
         import jax
 
         self._rng, sub = jax.random.split(self._rng)
+        if self.loop_mode == "pipelined" and jax.process_count() == 1:
+            # explicit placement beside the replicated params: the jitted
+            # update would otherwise reshard the key implicitly onto the
+            # mesh every epoch (the transfer-guard pin catches exactly
+            # this class of hidden per-update transfer). Single-process
+            # only: under multi-host the key must ride into the jit as a
+            # host-local value on every process (a device_put onto the
+            # global mesh would fabricate a global array per process)
+            replicated = getattr(getattr(self, "learner", None),
+                                 "_replicated", None)
+            if replicated is not None:
+                sub = jax.device_put(sub, replicated)
         return sub
 
     def _split_collect_rng(self):
@@ -433,37 +507,147 @@ class RLEpochLoop:
         self._collect_rng, sub = jax.random.split(self._collect_rng)
         return sub
 
+    # ------------------------------------------------- pipelining plumbing
+    def _collect_and_stage(self, params, rng):
+        """Collect one batch and stage it on the mesh (double-buffered
+        under ``pipeline_depth=1``: staging the next batch runs on the
+        collection thread while the update consumes the previous one,
+        whose donated buffers free as it runs)."""
+        with telemetry.span("train.collect"):
+            out = self.collector.collect(params, rng)
+        with telemetry.span("train.device_transfer"):
+            straj, slv = self.learner.shard_traj(out["traj"],
+                                                 out["last_values"])
+        return out, straj, slv
+
+    def _next_batch(self):
+        """The epoch's staged batch; under ``pipeline_depth=1`` also
+        kicks off the NEXT epoch's collection on the background thread
+        against the CURRENT (pre-update) params — once the caller
+        dispatches this epoch's update, that collection is exactly one
+        update stale, which V-trace corrects. The rng stream is split on
+        the main thread in submission order, so collection n consumes
+        the same key in every mode (bit-exactness across depths of what
+        each batch is collected WITH is not promised — staleness is the
+        point — but the rng bookkeeping stays deterministic and
+        process-local, preserving the multi-host rules)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._collect_future is not None:
+            future, self._collect_future = self._collect_future, None
+            out = future.result()
+        else:
+            out = self._collect_and_stage(self.state.params,
+                                          self._split_collect_rng())
+        if self.pipeline_depth:
+            if self._collect_executor is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._collect_executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="collect-pipeline")
+            # jnp.copy: the live state is about to be DONATED into the
+            # update, which deletes its param buffers out from under a
+            # concurrent reader; the stale collector needs its own copy
+            params = jax.tree_util.tree_map(jnp.copy, self.state.params)
+            rng = self._split_collect_rng()
+            self._collect_future = self._collect_executor.submit(
+                self._collect_and_stage, params, rng)
+        return out
+
+    def _watch_update(self, metrics, t0: float) -> None:
+        """Record the in-flight update's device wall as a
+        ``train.update_device`` span from a monitor thread, so the span
+        overlap view (telemetry.overlap_summary) can MEASURE how much of
+        it ran concurrently with collection instead of asserting it.
+        Only active while telemetry is enabled — the monitor blocks on
+        the device off the critical path."""
+        if not telemetry.enabled():
+            return
+        if self._watch_executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._watch_executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="update-watch")
+
+        def _block():
+            import jax
+
+            try:
+                jax.block_until_ready(metrics)
+                telemetry.record_span("train.update_device", t0)
+            except Exception:
+                pass  # observability must never break training
+
+        self._watch_executor.submit(_block)
+
+    def _harvest_metrics(self, metrics) -> Any:
+        """Sequential mode: the pre-pipelining per-update blocking fetch
+        (one ``train.host_sync`` span per epoch). Pipelined mode: wrap
+        the device dict as a LazyMetrics future on the unsynced ring;
+        ``_maybe_sync_metrics`` drains the ring at sync boundaries."""
+        import jax
+
+        if self.loop_mode == "sequential":
+            with telemetry.span("train.host_sync"):
+                return {k: float(v)
+                        for k, v in jax.device_get(metrics).items()}
+        from ddls_tpu.train.metrics import LazyMetrics
+
+        lazy = LazyMetrics(metrics)
+        self._metrics_ring.append(lazy)
+        return lazy
+
+    def _maybe_sync_metrics(self, force: bool = False) -> None:
+        """Drain the unsynced-metrics ring in ONE batched device fetch
+        when a sync boundary is reached (every ``metrics_sync_interval``
+        epochs, an eval epoch, or ``force``). The gate is deterministic
+        (epoch counter only) — multi-host safe."""
+        if not self._metrics_ring:
+            return
+        if not (force
+                or self.epoch_counter % self.metrics_sync_interval == 0):
+            return
+        from ddls_tpu.train.metrics import LazyMetrics
+
+        ring, self._metrics_ring = self._metrics_ring, []
+        with telemetry.span("train.host_sync"):
+            LazyMetrics.materialize_group(ring)
+
+    def sync_metrics(self) -> None:
+        """Force-drain any unsynced metrics (checkpoint/shutdown/test
+        boundary)."""
+        self._maybe_sync_metrics(force=True)
+
     def run(self) -> Dict[str, Any]:
         """Collect one trajectory batch and apply one PPO update.
 
         Per-update phase spans (no-ops while telemetry is disabled): note
         jax dispatch is async, so ``train.train_step`` measures trace/
-        dispatch and ``train.host_sync`` absorbs the device wait — the
-        pair is the update's wall cost, the split shows where the host
-        blocked (the attribution Podracer/MSRL instrument for)."""
-        import jax
-
+        dispatch and ``train.host_sync`` absorbs the device wait — in
+        sequential mode once per update, in pipelined mode once per sync
+        boundary, with ``train.update_device`` (monitor thread) carrying
+        the true device wall of the update (the attribution
+        Podracer/MSRL instrument for)."""
         start = time.time()
-        with telemetry.span("train.collect"):
-            out = self.collector.collect(self.state.params,
-                                         self._split_collect_rng())
-        with telemetry.span("train.device_transfer"):
-            straj, slv = self.learner.shard_traj(out["traj"],
-                                                 out["last_values"])
+        out, straj, slv = self._next_batch()
+        update_t0 = telemetry.clock_now() if telemetry.enabled() else 0.0
         with telemetry.span("train.train_step"):
             self.state, metrics = self.learner.train_step(
                 self.state, straj, slv, self._split_rng())
-        with telemetry.span("train.host_sync"):
-            metrics = {k: float(v)
-                       for k, v in jax.device_get(metrics).items()}
+        del straj, slv  # donated on accelerator backends: moved-from
+        if self.loop_mode == "pipelined":
+            self._watch_update(metrics, update_t0)
 
         self.epoch_counter += 1
         self.total_env_steps += out["env_steps"]
+        learner_metrics = self._harvest_metrics(metrics)
+        self._maybe_sync_metrics()
         results: Dict[str, Any] = {
             "epoch_counter": self.epoch_counter,
             "env_steps_this_iter": out["env_steps"],
             "total_env_steps": self.total_env_steps,
-            "learner": metrics,
+            "learner": learner_metrics,
         }
         return self._finalize_results(results, out["episodes"], start)
 
@@ -476,6 +660,16 @@ class RLEpochLoop:
 
         if (self.evaluation_interval
                 and self.epoch_counter % self.evaluation_interval == 0):
+            # eval is a logging boundary: drain any unsynced metric
+            # futures first (the deterministic eval gate itself already
+            # syncs the host with the device). A pipeline_depth=1
+            # background collection must also settle first — its env
+            # stepping draws from the process-global numpy/random state
+            # that evaluate() snapshots and reseeds, and racing those
+            # would corrupt both streams.
+            self._maybe_sync_metrics(force=True)
+            if self._collect_future is not None:
+                self._collect_future.result()
             with telemetry.span("train.eval"):
                 results["evaluation"] = self.evaluate(
                     self.evaluation_duration)
@@ -625,10 +819,12 @@ class RLEpochLoop:
         literal '/' (e.g. 'evaluation/custom_metrics/blocking_rate_mean'
         where 'custom_metrics/blocking_rate_mean' is one key): at each dict
         level the longest matching '/'-joined key wins."""
+        from collections.abc import Mapping
+
         def walk(node, segments):
             if not segments:
                 return node
-            if not isinstance(node, dict):
+            if not isinstance(node, Mapping):  # dicts AND LazyMetrics
                 return None
             for cut in range(len(segments), 0, -1):
                 key = "/".join(segments[:cut])
@@ -664,10 +860,14 @@ class RLEpochLoop:
         rllib_epoch_loop.py:144)."""
         if self.wandb is None:
             return
+        from collections.abc import Mapping
+
         flat = {}
 
         def walk(node, prefix=""):
-            if isinstance(node, dict):
+            if isinstance(node, Mapping):  # dicts AND LazyMetrics (the
+                # W&B flatten IS a logging boundary: iterating a pending
+                # LazyMetrics materialises it — one batched fetch)
                 for k, v in node.items():
                     walk(v, f"{prefix}{k}/")
             elif isinstance(node, (int, float, np.floating, np.integer)):
@@ -683,6 +883,17 @@ class RLEpochLoop:
         self.wandb.log(flat)
 
     def close(self) -> None:
+        if self._collect_future is not None:
+            try:  # leave the env workers in a consistent state
+                self._collect_future.result(timeout=60)
+            except Exception:
+                pass
+            self._collect_future = None
+        for executor in (self._collect_executor, self._watch_executor):
+            if executor is not None:
+                executor.shutdown(wait=True)
+        self._collect_executor = self._watch_executor = None
+        self.sync_metrics()
         self.vec_env.close()
 
 
@@ -724,14 +935,26 @@ class ApexDQNEpochLoop(RLEpochLoop):
             seed=self.seed)
         self._nstep_queues: List[List[dict]] = [
             [] for _ in range(self.num_envs)]
+        if (self.loop_mode == "pipelined"
+                and getattr(self.vec_env, "prefetch_stacked", None)
+                is False):
+            self.vec_env.prefetch_stacked = True
 
     def run(self) -> Dict[str, Any]:
         """Collect rollout_length epsilon-greedy steps per env into replay,
-        then apply ``training_intensity``-matched DQN updates."""
+        then apply ``training_intensity``-matched DQN updates.
+
+        Replay insertion and epsilon schedules keep collection on the
+        host, so only the metric-sync schedule changes between loop
+        modes: sequential fetches each update's metrics under its own
+        ``train.host_sync``; pipelined keeps the per-update dicts on
+        device and logs their mean as one LazyMetrics future (the
+        per-update ``td`` fetch stays — priorities feed the next
+        sample). ``pipeline_depth > 0`` is rejected in __init__."""
         import jax
 
         from ddls_tpu.rl.dqn import nstep_transitions, per_worker_epsilons
-        from ddls_tpu.rl.rollout import OBS_KEYS, stack_obs
+        from ddls_tpu.rl.rollout import OBS_KEYS
 
         def slim(obs):
             # keep only network-consumed keys (drops e.g. the constant
@@ -744,7 +967,9 @@ class ApexDQNEpochLoop(RLEpochLoop):
 
         with telemetry.span("train.collect"):
             for _ in range(T):
-                batched = stack_obs(self.vec_env.obs)
+                # stacked_obs: with the prefetching vec env this batch
+                # was assembled while the previous step's workers ran
+                batched = self.vec_env.stacked_obs()
                 eps = per_worker_epsilons(B, self.total_env_steps, cfg)
                 actions = np.asarray(self.learner.sample_actions(
                     self.state.params, batched, self._split_collect_rng(),
@@ -798,15 +1023,28 @@ class ApexDQNEpochLoop(RLEpochLoop):
                 # priority-update CPU time
                 with telemetry.span("train.replay_update"):
                     self.replay.update_priorities(idx, td)
-                with telemetry.span("train.host_sync"):
-                    metrics_acc.append({k: float(v) for k, v in
-                                        jax.device_get(metrics).items()})
+                if self.loop_mode == "sequential":
+                    with telemetry.span("train.host_sync"):
+                        metrics_acc.append({k: float(v) for k, v in
+                                            jax.device_get(metrics).items()})
+                else:
+                    metrics_acc.append(metrics)  # device futures
 
         self.epoch_counter += 1
-        learner_metrics = ({k: float(np.mean([m[k] for m in metrics_acc]))
-                            for k in metrics_acc[0]} if metrics_acc else {})
-        learner_metrics["num_updates"] = len(metrics_acc)
-        learner_metrics["replay_size"] = self.replay.size
+        extras = {"num_updates": len(metrics_acc),
+                  "replay_size": self.replay.size}
+        if self.loop_mode == "sequential":
+            learner_metrics = (
+                {k: float(np.mean([m[k] for m in metrics_acc]))
+                 for k in metrics_acc[0]} if metrics_acc else {})
+            learner_metrics.update(extras)
+        else:
+            from ddls_tpu.train.metrics import LazyMetrics
+
+            learner_metrics = LazyMetrics(metrics_acc, reduce="mean",
+                                          extras=extras)
+            self._metrics_ring.append(learner_metrics)
+            self._maybe_sync_metrics()
         results: Dict[str, Any] = {
             "epoch_counter": self.epoch_counter,
             "env_steps_this_iter": env_steps,
@@ -891,7 +1129,15 @@ class ImpalaEpochLoop(RLEpochLoop):
     """IMPALA epoch loop: the same vectorised collector as PPO (its one-
     epoch policy lag is exactly what V-trace corrects) with a single jitted
     V-trace update per batch (reference: algo/impala.yaml through
-    rllib_epoch_loop.py:34)."""
+    rllib_epoch_loop.py:34).
+
+    The one loop where ``pipeline_depth=1`` is sound: collection n+1 runs
+    on a background thread against params(n-1) while the device applies
+    update n — V-trace's importance weighting corrects exactly that
+    policy lag (one epoch deeper than the lag it already tolerates), in
+    the actor/learner-decoupled shape Podracer/MSRL/SEED-RL pipeline."""
+
+    SUPPORTS_STALE_COLLECTION = True
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.impala_cfg = impala_config_from_rllib(algo_config)
@@ -982,7 +1228,7 @@ class ESEpochLoop(RLEpochLoop):
         with telemetry.span("train.train_step"):
             self.state, metrics = self.learner.update(self.state, eps,
                                                       fitness)
-        metrics = {k: float(v) for k, v in jax.device_get(metrics).items()}
+        metrics = self._harvest_metrics(metrics)
         # training episodes are drained BEFORE any eval window so the eval
         # policy's episodes can never leak into the training stats
         completed_episodes = self.vec_env.drain_completed_episodes()
@@ -1010,6 +1256,9 @@ class ESEpochLoop(RLEpochLoop):
             self.vec_env.restart_episodes()
 
         self.epoch_counter += 1
+        # sync gate AFTER the increment, so the drain cadence matches the
+        # base/DQN loops (epochs interval, 2*interval, ...) exactly
+        self._maybe_sync_metrics()
         env_steps = self.rollout_length * self.num_envs
         self.total_env_steps += env_steps
         results: Dict[str, Any] = {

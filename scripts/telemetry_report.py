@@ -94,8 +94,37 @@ def _histogram_percentiles(summ: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+def _overlap_section(intervals: List[tuple]) -> List[str]:
+    """Concurrency accounting over the sink's ``train.*`` spans (each
+    record's interval is ``(ts - dur_s, ts)`` — the sink stamps ``ts``
+    at span exit). Makes pipelining claims checkable from any run's
+    JSONL: wall covered by >= 1 span, by >= 2 CONCURRENT spans (real
+    overlap, e.g. train.update_device under train.collect), and the
+    largest uncovered gaps (loop time no phase span accounts for)."""
+    from ddls_tpu.telemetry import overlap_summary
+
+    train = [iv for iv in intervals if iv[0].startswith("train.")]
+    ov = overlap_summary(train)
+    if not ov.get("n_spans"):
+        return []
+    window_t0 = min(t0 for _, t0, _ in train)
+    lines = ["== overlap (train.* spans, intervals from ts - dur_s) ==",
+             f"{'spans':<28}{ov['n_spans']:>10}",
+             f"{'window_s':<28}{ov['window_s']:>10.3f}",
+             f"{'covered_by_>=1_span_s':<28}{ov['covered_1_s']:>10.3f}",
+             f"{'covered_by_>=2_spans_s':<28}{ov['covered_2_s']:>10.3f}",
+             f"{'overlap_fraction':<28}{ov['overlap_fraction']:>10.3f}",
+             f"{'uncovered_gap_s':<28}{ov['gap_s']:>10.3f}"]
+    for i, gap in enumerate(ov["largest_gaps"], 1):
+        lines.append(f"{'gap_' + str(i) + '_s':<28}{gap['dur_s']:>10.3f}"
+                     f"  (at +{gap['start'] - window_t0:.3f}s into the "
+                     f"window)")
+    return lines + [""]
+
+
 def render_report(path: str) -> List[str]:
     span_durations: Dict[str, List[float]] = defaultdict(list)
+    span_intervals: List[tuple] = []
     event_counts: Dict[tuple, int] = defaultdict(int)
     event_last: Dict[tuple, dict] = {}
     last_snapshot: Dict[str, Any] = {}
@@ -113,8 +142,12 @@ def render_report(path: str) -> List[str]:
                 continue
             kind = rec.get("type")
             if kind == "span":
-                span_durations[rec.get("name", "?")].append(
-                    float(rec.get("dur_s", 0.0)))
+                dur = float(rec.get("dur_s", 0.0))
+                span_durations[rec.get("name", "?")].append(dur)
+                if rec.get("ts") is not None:
+                    ts = float(rec["ts"])
+                    span_intervals.append(
+                        (rec.get("name", "?"), ts - dur, ts))
             elif kind == "event":
                 key = (rec.get("kind", "?"), rec.get("phase"))
                 event_counts[key] += 1
@@ -128,6 +161,8 @@ def render_report(path: str) -> List[str]:
         lines += ["== spans (from per-span records; exact percentiles) =="]
         lines += _span_table(span_durations)
         lines += [""]
+    if span_intervals:
+        lines += _overlap_section(span_intervals)
     if event_counts:
         lines += ["== events ==",
                   f"{'kind':<24}{'phase':<18}{'count':>7}  last"]

@@ -154,7 +154,19 @@ def test_rl_epoch_loop_end_to_end(dataset_dir, tmp_path):
         r2 = loop.run()
         spans = telemetry.span_summaries()
         assert {"train.collect", "train.device_transfer",
-                "train.train_step", "train.host_sync"} <= set(spans)
+                "train.train_step"} <= set(spans)
+        # pipelined default (PR 4): metrics stay device futures — no
+        # per-update host_sync; the update's device wall is carried by
+        # the monitor-thread span instead, and an explicit sync drains
+        # the ring under exactly one host_sync span
+        assert "train.host_sync" not in spans
+        loop.sync_metrics()
+        if loop._watch_executor is not None:  # settle the monitor span
+            loop._watch_executor.shutdown(wait=True)
+            loop._watch_executor = None
+        spans = telemetry.span_summaries()
+        assert spans["train.host_sync"]["count"] == 1
+        assert "train.update_device" in spans
         assert all(s["count"] == 1 for s in spans.values())
     finally:
         telemetry.reset()
