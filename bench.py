@@ -275,8 +275,12 @@ def _available_cores() -> int:
     return available_cores()
 
 
-def _make_vec_env(dataset_dir: str, num_envs: int):
-    """Subprocess workers when there are cores for them, else in-process."""
+def _make_vec_env(dataset_dir: str, num_envs: int, backend: str = "pipe"):
+    """Subprocess workers when there are cores for them, else in-process.
+    ``backend`` selects the subprocess obs transport (rl/rollout.py):
+    sim mode stays on ``pipe`` so the loop_efficiency denominator keeps
+    the seed's exact cost profile; the ppo loop takes --vec-backend
+    (default auto = shm where usable)."""
     from ddls_tpu.envs import RampJobPartitioningEnvironment
     from ddls_tpu.rl.rollout import ParallelVectorEnv, VectorEnv
 
@@ -284,7 +288,7 @@ def _make_vec_env(dataset_dir: str, num_envs: int):
     seeds = list(range(num_envs))
     if _available_cores() > 1:
         return ParallelVectorEnv(RampJobPartitioningEnvironment, kwargs,
-                                 num_envs, seeds=seeds)
+                                 num_envs, seeds=seeds, backend=backend)
     return VectorEnv([lambda: RampJobPartitioningEnvironment(**kwargs)
                       for _ in range(num_envs)], seeds=seeds)
 
@@ -340,6 +344,199 @@ def run_sim_bench(args) -> dict:
         # (lookahead/partition memo hit rates) from the same snapshot
         "telemetry": telemetry.snapshot(),
     }
+
+
+def run_collect_bench(args) -> dict:
+    """Interleaved same-process pipe-vs-shm A/B of the rollout-collection
+    obs transport (ISSUE 5; the --loop-mode both discipline: S/P rounds
+    alternate in ONE process so box-load drift can't masquerade as a
+    backend effect, shm timed first = drift-conservative for its claim).
+
+    Drives exactly the collect tax and nothing else: per step, stacked
+    [B, ...] batch assembly + the [T, B, ...] trajectory materialisation
+    (the pipe path pays pickle + stack + traj copy; the shm path's
+    worker writes land straight in the [T+1, B, ...] slab), with
+    deterministic first-valid actions so both backends step IDENTICAL
+    env trajectories. No learner in the loop — the sampling cost is the
+    same either way and would only dilute the measured difference.
+
+    ``collect_bytes_per_step`` sums the rollout.obs.bytes_* telemetry
+    counters (parent-side materialisations of obs bytes) over each
+    backend's timed rounds — fully measured, no estimate.
+
+    Padding: defaults to the REFERENCE 150-node obs pad (the canonical
+    experimental setup the headline bench names; --collect-pad-nodes /
+    --collect-pad-edges override). The transport tax scales with padded
+    obs bytes, so the dataset-tight pads the ppo loop runs under
+    (docs/perf_round2.md) shrink it to the noise floor of env stepping
+    on a slow box — the A/B measures the regime the tax was indicted
+    in (BENCH_r05 and arXiv 2012.04210 both describe full-pad
+    transfers)."""
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.rl.rollout import OBS_KEYS, ParallelVectorEnv
+    from ddls_tpu.rl.shm import shm_available
+
+    dataset_dir = _make_dataset()
+    kwargs = make_env_kwargs(dataset_dir)
+    if args.collect_pad_nodes:
+        kwargs["pad_obs_kwargs"] = {"max_nodes": args.collect_pad_nodes,
+                                    "max_edges": args.collect_pad_edges}
+    if args.collect_topology == "light":
+        # transport-isolating env: an 8-server topology with a short
+        # lookahead horizon makes sim stepping cheap, so the obs
+        # transport term is a measurable fraction of the step wall
+        # instead of ~3% noise under the canonical 32-server sim (the
+        # obs SIZE — what transport cost scales with — is set by the
+        # pad above, not the topology). Both backends still step
+        # identical trajectories, so any paired difference is transport.
+        kwargs["topology_config"]["kwargs"].update(
+            num_communication_groups=2,
+            num_racks_per_communication_group=2,
+            num_servers_per_rack=2)
+        kwargs["node_config"] = {"type_1": {
+            "num_nodes": 8,
+            "workers_config": [{"num_workers": 1, "worker": "A100"}]}}
+        kwargs["jobs_config"]["num_training_steps"] = 2
+        kwargs["max_simulation_run_time"] = 5e4
+    T = args.rollout_length
+    B = args.num_envs
+    backends = ["pipe"] + (["shm"] if shm_available() else [])
+    vecs = {}
+    for backend in backends:
+        vecs[backend] = ParallelVectorEnv(
+            RampJobPartitioningEnvironment, kwargs, B,
+            seeds=list(range(B)), backend=backend)
+        vecs[backend].reset()
+    # the shm env can silently fall back to pipe at reset (slab
+    # allocation failure — e.g. /dev/shm too small for this pad); a
+    # pipe-vs-pipe A/B must never be published under an "shm" label
+    if "shm" in vecs and vecs["shm"].backend != "shm":
+        vecs.pop("shm").close()
+        backends.remove("shm")
+    # pipe runs its BEST configuration (the round-6 out-of-order
+    # prefetch assembly) so the A/B measures shm against the strongest
+    # incumbent, not a strawman
+    vecs["pipe"].prefetch_stacked = True
+
+    telemetry.enable()
+    trajs = {backend: None for backend in backends}
+
+    def collect_segment(backend):
+        """One [T, B] segment on ``backend``, the deferred-fetch
+        collector's obs schedule minus the learner — including the shm
+        side's one BULK copy of the slab rows into a fresh buffer at
+        segment end (the collector's aliasing-safe staging, rollout.py
+        _collect_deferred): T per-step copies on pipe vs one memcpy on
+        shm, both counted in bytes_traj_copy."""
+        vec = vecs[backend]
+        ensure = getattr(vec, "ensure_traj_rows", None)
+        use_slab = bool(ensure is not None and ensure(T + 1))
+        if use_slab:
+            vec.rebase_row0()
+        traj = trajs[backend]
+        for t in range(T):
+            batched = vec.stacked_obs()
+            # deterministic first-valid action (index 0 = do-not-place is
+            # always valid): identical trajectories on both backends
+            actions = np.asarray(batched["action_mask"]).argmax(axis=1)
+            if not use_slab:
+                if traj is None:
+                    traj = trajs[backend] = {
+                        k: np.empty((T,) + np.asarray(batched[k]).shape,
+                                    np.asarray(batched[k]).dtype)
+                        for k in OBS_KEYS}
+                for k in OBS_KEYS:
+                    traj[k][t] = batched[k]
+                telemetry.inc("rollout.obs.bytes_traj_copy",
+                              sum(np.asarray(batched[k]).nbytes
+                                  for k in OBS_KEYS))
+            vec.step(actions.astype(np.int32))
+        if use_slab:
+            staged = {k: np.array(v)
+                      for k, v in vec.traj_obs_views(T).items()}
+            telemetry.inc("rollout.obs.bytes_traj_copy",
+                          sum(v.nbytes for v in staged.values()))
+        return T * B
+
+    def rollout_byte_counters() -> int:
+        counters = telemetry.snapshot().get("counters") or {}
+        return sum(int(v) for k, v in counters.items()
+                   if k.startswith("rollout.obs.bytes_"))
+
+    # warmup: past the memo-cache transient, both backends equally
+    with telemetry.span("bench.warmup"):
+        for _ in range(args.collect_warmup_segments):
+            for backend in backends:
+                collect_segment(backend)
+
+    acc = {backend: {"steps": 0, "wall": 0.0, "bytes": 0, "segments": 0,
+                     "rates": []} for backend in backends}
+    # paired rounds, alternating lead: both backends step IDENTICAL
+    # trajectories (same seeds, deterministic actions), so within a
+    # round they do the same sim work adjacent in time — the per-round
+    # rate ratio isolates the transport term from the box's drift
+    # (invisible throttling swings absolute rates severalfold between
+    # minutes — VERDICT r5; a totals ratio aliases that drift, the
+    # MEDIAN of paired ratios does not)
+    for r in range(args.collect_rounds):
+        order = backends if r % 2 else list(reversed(backends))
+        for backend in order:
+            a = acc[backend]
+            bytes_mark = rollout_byte_counters()
+            with telemetry.span(f"bench.run_{backend}") as seg_span:
+                n = collect_segment(backend)
+            a["steps"] += n
+            a["wall"] += seg_span.duration_s
+            a["bytes"] += rollout_byte_counters() - bytes_mark
+            a["segments"] += 1
+            a["rates"].append(n / seg_span.duration_s)
+    for vec in vecs.values():
+        vec.close()
+
+    results = {}
+    for backend in backends:
+        a = acc[backend]
+        rates = np.asarray(a["rates"])
+        results[backend] = {
+            "env_steps_per_sec": round(a["steps"] / a["wall"], 2),
+            "per_round_env_steps_per_sec": [round(float(x), 2)
+                                            for x in rates],
+            "median_round_env_steps_per_sec": round(
+                float(np.median(rates)), 2),
+            "collect_bytes_per_step": round(a["bytes"] / a["steps"], 1),
+            "timed_segments": a["segments"],
+        }
+    headline = "shm" if "shm" in results else "pipe"
+    payload = {
+        "metric": "collect_env_steps_per_sec",
+        "value": results[headline]["median_round_env_steps_per_sec"],
+        "unit": "env_steps/s",
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "vec_backend": headline,
+        "topology": args.collect_topology,
+        "vec_backends": results,
+        "collect_bytes_per_step": results[headline][
+            "collect_bytes_per_step"],
+        "num_envs": B,
+        "rollout_length": T,
+        "cores": _available_cores(),
+        "telemetry": telemetry.snapshot(),
+    }
+    if "shm" in results and "pipe" in results:
+        paired = [s / p for s, p in zip(acc["shm"]["rates"],
+                                        acc["pipe"]["rates"])]
+        payload["paired_round_speedups"] = [round(x, 3) for x in paired]
+        # the headline comparison: median over paired rounds (see above)
+        payload["shm_speedup_vs_pipe"] = round(
+            float(np.median(paired)), 3)
+        payload["pipe_bytes_per_step_vs_shm"] = round(
+            results["pipe"]["collect_bytes_per_step"]
+            / max(results["shm"]["collect_bytes_per_step"], 1.0), 2)
+    else:
+        payload["platform_note"] = ("POSIX shared memory unavailable; "
+                                    "pipe backend only")
+    return payload
 
 
 def run_jaxenv_bench(args) -> dict:
@@ -632,7 +829,8 @@ def run_bench(args, platform_note: str | None,
 
     n_actions = 17
     model = GNNPolicy(n_actions=n_actions)
-    vec = _make_vec_env(_make_dataset(), args.num_envs)
+    vec = _make_vec_env(_make_dataset(), args.num_envs,
+                        backend=args.vec_backend)
     vec.reset()
     single = jax.tree_util.tree_map(np.asarray, vec.obs[0])
     params = model.init(jax.random.PRNGKey(0), single)
@@ -869,6 +1067,9 @@ def run_bench(args, platform_note: str | None,
         "num_envs": args.num_envs,  # after device-multiple rounding
         "rollout_length": args.rollout_length,
         "num_sgd_iter": args.num_sgd_iter,
+        # the resolved obs transport ("inproc" = serial VectorEnv on a
+        # 1-core box); sim's denominator below always measures on pipe
+        "vec_env_backend": getattr(vec, "backend", "inproc"),
         "timed_epochs": epochs_run,
         # the early-break above can cut warmup short of the ~320 steps/env
         # the CPU smoke sizing targets; recording the achieved count makes
@@ -997,12 +1198,49 @@ def _run_probed_mode(args, runner, metric: str, unit: str) -> int:
 def main(argv=None) -> int:
     process_start = time.perf_counter()
     parser = argparse.ArgumentParser()
-    parser.add_argument("--mode", choices=("ppo", "sim", "jaxenv", "serve"),
+    parser.add_argument("--mode",
+                        choices=("ppo", "sim", "jaxenv", "serve",
+                                 "collect"),
                         default="ppo",
                         help="ppo: full train loop; sim: pure env "
                              "stepping; jaxenv: fully-jitted episodes; "
                              "serve: online policy serving at offered "
-                             "load (ddls_tpu/serve)")
+                             "load (ddls_tpu/serve); collect: "
+                             "interleaved pipe-vs-shm obs-transport A/B "
+                             "(rollout collection only, no learner)")
+    parser.add_argument("--vec-backend", choices=("auto", "pipe", "shm"),
+                        default="auto",
+                        help="ppo mode's subprocess obs transport "
+                             "(rl/rollout.py; auto = shm where POSIX "
+                             "shm is usable). sim mode always measures "
+                             "on pipe — the loop_efficiency denominator "
+                             "keeps the seed's cost profile")
+    parser.add_argument("--collect-rounds", type=int, default=12,
+                        help="collect mode: interleaved timed rounds "
+                             "per backend (one [T, B] segment each, "
+                             "lead backend alternating per round; the "
+                             "headline speedup is the MEDIAN of paired "
+                             "per-round ratios)")
+    parser.add_argument("--collect-topology",
+                        choices=("light", "canonical"), default="light",
+                        help="collect mode env: light (8-server, short "
+                             "horizon — cheap sim steps so the obs "
+                             "transport term is measurable) or "
+                             "canonical (the 32-server reference sim, "
+                             "where transport is a few %% of the step "
+                             "wall)")
+    parser.add_argument("--collect-warmup-segments", type=int, default=10,
+                        help="collect mode: warmup segments per backend "
+                             "before timing (default 10 x 32 steps "
+                             "clears the ~300-step memo-cache "
+                             "transient)")
+    parser.add_argument("--collect-pad-nodes", type=int, default=150,
+                        help="collect mode obs pad (reference 150-node "
+                             "canonical pad; 0 = the dataset-tight "
+                             "bound the ppo loop uses)")
+    parser.add_argument("--collect-pad-edges", type=int, default=512,
+                        help="collect mode edge pad bound (with "
+                             "--collect-pad-nodes)")
     parser.add_argument("--jaxenv-max-degree", type=int, default=8)
     parser.add_argument("--serve-requests", type=int, default=256)
     parser.add_argument("--serve-rps", type=float, default=200.0,
@@ -1109,6 +1347,19 @@ def _dispatch_mode(args, process_start: float) -> int:
         except Exception:
             tb = traceback.format_exc().strip().splitlines()
             emit({"metric": "sim_env_steps_per_sec", "value": None,
+                  "unit": "env_steps/s", "vs_baseline": None,
+                  "error": " | ".join(tb[-3:])})
+            return 1
+
+    if args.mode == "collect":
+        # host-only obs-transport A/B: like sim, no device in the loop
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            emit(run_collect_bench(args))
+            return 0
+        except Exception:
+            tb = traceback.format_exc().strip().splitlines()
+            emit({"metric": "collect_env_steps_per_sec", "value": None,
                   "unit": "env_steps/s", "vs_baseline": None,
                   "error": " | ".join(tb[-3:])})
             return 1

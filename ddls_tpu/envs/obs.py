@@ -279,15 +279,46 @@ def _pad1(x: np.ndarray, n: int) -> np.ndarray:
     return out
 
 
+def _pad_into(x: np.ndarray, dst: np.ndarray, rows: int,
+              key: str) -> None:
+    """Write ``x`` into the first ``len(x)`` rows of ``dst`` and zero the
+    rest — the in-place twin of ``_pad2``/``_pad1`` (the destination may
+    hold stale bytes from a previous occupant, so the dead region must be
+    re-zeroed, exactly the masked-pad policy)."""
+    if dst.shape[0] != rows:
+        raise ValueError(f"out[{key!r}] has {dst.shape[0]} rows, pad "
+                         f"target is {rows}")
+    k = len(x)
+    dst[:k] = x
+    dst[k:] = 0
+
+
+# fields pad_obs_to re-pads; everything else passes through unchanged
+_REPADDED_KEYS = ("node_features", "edge_features", "edges_src",
+                  "edges_dst", "node_split", "edge_split")
+
+
 def pad_obs_to(obs: Dict[str, np.ndarray], max_nodes: int,
-               max_edges: int) -> Dict[str, np.ndarray]:
+               max_edges: int,
+               out: Optional[Dict[str, np.ndarray]] = None
+               ) -> Dict[str, np.ndarray]:
     """Re-pad an encoded observation to a different (max_nodes, max_edges)
     pad target, keeping exactly the true rows (``node_split``/``edge_split``)
     and zero-filling the rest — the same masked-pad policy ``encode`` uses,
     so the repad changes which rows are dead padding but never a real row.
     The serving bucketer (serve/bucketing.py) uses this to snap incoming
     observations, whatever bound the client padded to, onto its fixed
-    bucket shapes."""
+    bucket shapes.
+
+    ``out`` (encode-into-destination): a dict of caller-owned destination
+    arrays — shared-memory slab slices (rl/shm.py), serve arenas
+    (serve/bucketing.py) — written in place instead of allocated. Padded
+    fields land under the same policy (real rows copied, dead region
+    zeroed — bit-for-bit with the allocating path); any other field
+    present in ``out`` (graph_features, action_mask, ...) is copied into
+    its destination; obs fields absent from ``out`` pass through by
+    reference. The returned dict maps each written field to its ``out``
+    array."""
     n = int(np.asarray(obs["node_split"]).reshape(-1)[0])
     m = int(np.asarray(obs["edge_split"]).reshape(-1)[0])
     if n > max_nodes:
@@ -296,13 +327,57 @@ def pad_obs_to(obs: Dict[str, np.ndarray], max_nodes: int,
     if m > max_edges:
         raise ValueError(f"obs has {m} deps but pad target "
                          f"max_edges={max_edges}")
-    out = dict(obs)
-    out["node_features"] = _pad2(
-        np.asarray(obs["node_features"], dtype=np.float32)[:n], max_nodes)
-    out["edge_features"] = _pad2(
-        np.asarray(obs["edge_features"], dtype=np.float32)[:m], max_edges)
+    node = np.asarray(obs["node_features"], dtype=np.float32)[:n]
+    edge = np.asarray(obs["edge_features"], dtype=np.float32)[:m]
+    if out is None:
+        res = dict(obs)
+        res["node_features"] = _pad2(node, max_nodes)
+        res["edge_features"] = _pad2(edge, max_edges)
+        for key in ("edges_src", "edges_dst"):
+            res[key] = _pad1(np.asarray(obs[key], dtype=np.int32)[:m],
+                             max_edges)
+        res["node_split"] = np.array([n], dtype=np.int32)
+        res["edge_split"] = np.array([m], dtype=np.int32)
+        return res
+    res = dict(obs)
+    _pad_into(node, out["node_features"], max_nodes, "node_features")
+    _pad_into(edge, out["edge_features"], max_edges, "edge_features")
     for key in ("edges_src", "edges_dst"):
-        out[key] = _pad1(np.asarray(obs[key], dtype=np.int32)[:m], max_edges)
-    out["node_split"] = np.array([n], dtype=np.int32)
-    out["edge_split"] = np.array([m], dtype=np.int32)
-    return out
+        _pad_into(np.asarray(obs[key], dtype=np.int32)[:m], out[key],
+                  max_edges, key)
+    out["node_split"][...] = n
+    out["edge_split"][...] = m
+    for key, dst in out.items():
+        if key not in _REPADDED_KEYS:
+            np.copyto(dst, np.asarray(obs[key]))
+    res.update(out)
+    return res
+
+
+def write_obs_into(obs: Dict[str, np.ndarray],
+                   out: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Write an encoded observation into caller-owned destination arrays
+    (a shared-memory slab slice, a serve arena) under the masked-pad
+    policy, inferring the pad target from the destination's own row
+    counts — the worker-side write primitive of the zero-copy rollout
+    backend (rl/shm.py)."""
+    return pad_obs_to(obs, int(out["node_features"].shape[0]),
+                      int(out["edge_features"].shape[0]), out=out)
+
+
+class ObsWriter:
+    """Encode-into-destination helper bound to one (max_nodes, max_edges)
+    pad target: ``write(obs, out)`` re-pads ``obs`` into the caller's
+    arrays, bit-for-bit with the allocating ``pad_obs_to``. The shm env
+    worker (rl/rollout.py) builds one per slab attachment so the per-step
+    write carries the pad target instead of re-deriving it from the
+    destination's shape each call (which is what ``write_obs_into`` does
+    for one-off writes)."""
+
+    def __init__(self, max_nodes: int, max_edges: int):
+        self.max_nodes = int(max_nodes)
+        self.max_edges = int(max_edges)
+
+    def write(self, obs: Dict[str, np.ndarray],
+              out: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return pad_obs_to(obs, self.max_nodes, self.max_edges, out=out)

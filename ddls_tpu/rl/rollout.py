@@ -67,12 +67,32 @@ class VectorEnv:
         self.episode_returns = np.zeros(self.num_envs)
         self.episode_lengths = np.zeros(self.num_envs, dtype=np.int64)
         self.completed_episodes: List[Dict[str, Any]] = []
+        self._stacked_bufs: Optional[Dict[str, np.ndarray]] = None
 
     def stacked_obs(self) -> Dict[str, np.ndarray]:
-        """The current obs list as one [B, ...] batch (in-process envs
-        have no stepping to overlap the stacking with — see
-        ParallelVectorEnv.stacked_obs for the prefetched variant)."""
-        return stack_obs(self.obs)
+        """The current obs list as one [B, ...] batch, assembled into a
+        REUSED preallocated buffer (values bit-identical to
+        ``stack_obs(self.obs)``; contents valid until the next
+        ``stacked_obs()`` call — every current consumer copies or stages
+        the batch before stepping again). The single-process half of the
+        per-step obs copy tax: one allocation per run instead of one per
+        step. (In-process envs have no stepping to overlap the stacking
+        with — see ParallelVectorEnv for the prefetched/shm variants.)"""
+        arrays = {k: [np.asarray(o[k]) for o in self.obs]
+                  for k in OBS_KEYS}
+        bufs = self._stacked_bufs
+        if bufs is None or any(
+                bufs[k].shape != (self.num_envs,) + arrays[k][0].shape
+                or bufs[k].dtype != arrays[k][0].dtype for k in OBS_KEYS):
+            bufs = {k: np.empty((self.num_envs,) + arrays[k][0].shape,
+                                arrays[k][0].dtype) for k in OBS_KEYS}
+            self._stacked_bufs = bufs
+        for k in OBS_KEYS:
+            np.stack(arrays[k], out=bufs[k])
+        if telemetry.enabled():
+            telemetry.inc("rollout.obs.bytes_stack",
+                          sum(b.nbytes for b in bufs.values()))
+        return bufs
 
     def reset(self) -> List[Dict[str, np.ndarray]]:
         self.obs = [env.reset(seed=self.seeds[i])
@@ -150,7 +170,17 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
     the worker's counters — the sim-layer cache hit/miss counts live
     HERE, not in the parent — ride back on the "closed" ack and are
     merged into the parent registry by ``ParallelVectorEnv.close``.
+
+    Shared-memory protocol (the ``shm`` backend): on ``shm_open`` the
+    worker maps the parent's slabs (rl/shm.py); step commands then carry
+    ``(action, dest_row)`` and the observation is written in place into
+    this worker's ``[dest_row, env_index]`` slice via the masked-pad
+    ``envs.obs.write_obs_into`` — the pipe reply shrinks to the
+    (reward, done, record) control payload, which doubles as the ready
+    flag the parent waits on before reading the slice.
     """
+    attachment = None
+    writer = None  # set with the attachment on shm_open
     try:
         if telemetry_enabled:
             telemetry.enable()
@@ -171,8 +201,23 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
                 obs = env.reset(seed=seed)
                 episode_return, episode_length = 0.0, 0
                 conn.send(("obs", obs))
+            elif cmd == "shm_open":
+                from ddls_tpu.envs.obs import ObsWriter
+                from ddls_tpu.rl.shm import SlabAttachment
+
+                if attachment is not None:
+                    attachment.close()
+                attachment = SlabAttachment(payload)
+                writer = ObsWriter(
+                    attachment.views["node_features"].shape[2],
+                    attachment.views["edge_features"].shape[2])
+                conn.send(("ok", None))
             elif cmd == "step":
-                obs, reward, done, _ = env.step(int(payload))
+                if isinstance(payload, tuple):
+                    action, dest_row = payload
+                else:
+                    action, dest_row = payload, None
+                obs, reward, done, _ = env.step(int(action))
                 episode_return += reward
                 episode_length += 1
                 record = None
@@ -182,7 +227,14 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
                     seed += seed_stride
                     obs = env.reset(seed=seed)
                     episode_return, episode_length = 0.0, 0
-                conn.send(("step", (obs, float(reward), bool(done), record)))
+                if attachment is not None and dest_row is not None:
+                    writer.write(obs, {k: v[dest_row, env_index]
+                                       for k, v in
+                                       attachment.views.items()})
+                    conn.send(("step", (float(reward), bool(done), record)))
+                else:
+                    conn.send(("step",
+                               (obs, float(reward), bool(done), record)))
             elif cmd == "close":
                 # counters only: cross-process histogram merge is lossy,
                 # and the sim layer records nothing but counters
@@ -194,6 +246,29 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
     except Exception as e:  # surface worker crashes to the parent
         import traceback
         conn.send(("error", f"{e}\n{traceback.format_exc()}"))
+    finally:
+        if attachment is not None:
+            attachment.close()
+
+
+class _LazyObsList:
+    """Sequence facade over a shm-backend env's per-env obs dicts: the
+    ``step()`` return value materialises slab copies only if someone
+    actually indexes/iterates it (the PPO/IMPALA hot paths ignore the
+    obs return entirely — paying B copies per step there would undo the
+    zero-copy win)."""
+
+    def __init__(self, env):
+        self._env = env
+
+    def __len__(self):
+        return self._env.num_envs
+
+    def __getitem__(self, i):
+        return self._env.obs[i]
+
+    def __iter__(self):
+        return iter(self._env.obs)
 
 
 class ParallelVectorEnv:
@@ -203,12 +278,45 @@ class ParallelVectorEnv:
     picklable (builder callable + kwargs dict), since workers are spawned
     fresh — which also keeps the TPU runtime out of the children (only the
     parent process touches jax).
+
+    ``backend`` selects the obs transport:
+
+    * ``"pipe"`` (default — the seed's exact semantics): workers pickle
+      the full padded obs over the control pipe every step;
+    * ``"shm"``: workers write each obs once, in place, into per-field
+      shared-memory slabs (rl/shm.py) and the pipe carries only the
+      (reward, done, record) ready flag. ``stacked_obs()`` then returns
+      VIEWS of the slab (valid until the next ``step``/``reset``;
+      ``.obs`` materialises per-env copies on access), and
+      ``ensure_traj_rows(T + 1)`` grows the slabs so the deferred-fetch
+      collector's trajectory is the slab itself — the worker's write IS
+      the traj-buffer write. Bit-identical outputs to ``pipe`` (obs,
+      rewards, dones, episode-record content and order) for the same
+      seeds — pinned by tests/test_shm.py;
+    * ``"auto"``: ``shm`` where POSIX shared memory is usable, else
+      ``pipe``.
     """
 
     def __init__(self, env_builder: Callable[..., Any],
                  env_kwargs: Dict[str, Any], num_envs: int,
                  seeds: Optional[List[int]] = None,
-                 start_method: str = "spawn"):
+                 start_method: str = "spawn",
+                 backend: str = "pipe"):
+        from ddls_tpu.rl.shm import shm_available
+
+        if backend == "auto":
+            backend = "shm" if shm_available() else "pipe"
+        if backend not in ("pipe", "shm"):
+            raise ValueError(f"backend must be 'pipe', 'shm' or 'auto', "
+                             f"got {backend!r}")
+        if backend == "shm" and not shm_available():
+            import warnings
+
+            warnings.warn("POSIX shared memory unavailable; "
+                          "ParallelVectorEnv falling back to the pipe "
+                          "backend")
+            backend = "pipe"
+        self.backend = backend
         self.num_envs = num_envs
         self.seeds = seeds or list(range(num_envs))
         # opt-in (the pipelined collector sets it): full-batch step()
@@ -217,9 +325,26 @@ class ParallelVectorEnv:
         # next sample's input assembles while slower workers still step
         # — the stacking cost rides inside the env wall instead of after
         # it. Off by default so the sequential loop keeps the seed's
-        # exact cost profile for load-controlled comparisons.
+        # exact cost profile for load-controlled comparisons. (The shm
+        # backend subsumes it: stacked_obs IS the slab.)
         self.prefetch_stacked = False
         self._stacked_cache: Optional[Dict[str, np.ndarray]] = None
+        self._stacked_bufs: Optional[Dict[str, np.ndarray]] = None
+        # shm-backend state: slabs are allocated lazily at the first
+        # reset (field shapes come from a real obs), row 0 holds the
+        # current obs until ensure_traj_rows grows the slab
+        self._slabs = None
+        self._field_specs = None
+        self._cur_row = 0
+        self._obs_list: List[Dict[str, np.ndarray]] = []
+        self._obs_cache: Optional[List[Dict[str, np.ndarray]]] = None
+        self._extra_obs: Optional[List[Dict[str, np.ndarray]]] = None
+        self._obs_nbytes = 0
+        # bounded step wait: a wedged worker raises instead of hanging
+        # collection forever (a DEAD worker is detected immediately via
+        # pipe EOF, no timeout needed)
+        self.step_timeout_s = 300.0
+        self._closed = False
         ctx = mp.get_context(start_method)
         self._conns = []
         self._procs = []
@@ -235,15 +360,199 @@ class ParallelVectorEnv:
             self._conns.append(parent)
             self._procs.append(proc)
         self.completed_episodes: List[Dict[str, Any]] = []
-        self.obs: List[Dict[str, np.ndarray]] = []
         self._first_reset = True
 
+    # ------------------------------------------------------------- obs views
+    @property
+    def obs(self) -> List[Dict[str, np.ndarray]]:
+        """Per-env obs dicts. Pipe backend: the worker-sent dicts. Shm
+        backend: copies materialised from the slab on access (cached
+        until the next step) plus the reset-time non-slab fields
+        (``action_set`` — episode-constant by the encode contract); the
+        copies stay valid across later steps, so replay-style consumers
+        (the DQN loop's ``prev_obs``) are safe."""
+        if self._slabs is None:
+            return self._obs_list
+        if self._obs_cache is None:
+            row = self._cur_row
+            views = self._slabs.views
+            extra = self._extra_obs or [{}] * self.num_envs
+            self._obs_cache = [
+                {**extra[i],
+                 **{k: np.array(views[k][row, i]) for k in OBS_KEYS}}
+                for i in range(self.num_envs)]
+        return self._obs_cache
+
+    @obs.setter
+    def obs(self, value) -> None:
+        self._obs_list = list(value)
+        self._obs_cache = self._obs_list if self._slabs is not None else None
+
+    def _send(self, i: int, msg) -> None:
+        """Guarded dispatch: a worker that died before this command
+        surfaces as a clear error instead of an unhandled
+        BrokenPipeError (the kill-a-worker hardening path)."""
+        try:
+            self._conns[i].send(msg)
+        except (BrokenPipeError, OSError):
+            exitcode = self._procs[i].exitcode
+            self.close()
+            raise RuntimeError(
+                f"env worker {i} died (exitcode {exitcode}) — cannot "
+                f"dispatch {msg[0]!r}") from None
+
     def _recv(self, conn) -> Tuple[str, Any]:
-        kind, payload = conn.recv()
+        i = self._conns.index(conn)
+        if not conn.poll(self.step_timeout_s):
+            self.close()
+            raise RuntimeError(
+                f"env worker {i} did not reply within "
+                f"{self.step_timeout_s:.0f}s (wedged worker?)")
+        try:
+            kind, payload = conn.recv()
+        except (EOFError, ConnectionResetError, OSError):
+            exitcode = self._procs[i].exitcode
+            self.close()
+            raise RuntimeError(
+                f"env worker {i} died (exitcode {exitcode}) — pipe "
+                f"closed before its reply") from None
         if kind == "error":
             self.close()
             raise RuntimeError(f"env worker failed:\n{payload}")
         return kind, payload
+
+    def _drain_step_replies(self, on_reply) -> None:
+        """One step reply per worker, consumed OUT OF ORDER as workers
+        finish, under the bounded ``step_timeout_s`` deadline —
+        ``on_reply(i, payload)`` handles each. The single drain loop
+        shared by the shm and pipe-prefetch step paths, so the
+        dead-worker (pipe EOF) and wedged-worker (deadline) handling
+        can never diverge between transports."""
+        from multiprocessing import connection as mp_connection
+
+        remaining = {conn: i for i, conn in enumerate(self._conns)}
+        deadline = time.monotonic() + self.step_timeout_s
+        while remaining:
+            ready = mp_connection.wait(
+                list(remaining), timeout=max(deadline - time.monotonic(),
+                                             0.0))
+            if not ready:
+                stuck = sorted(remaining.values())
+                self.close()
+                raise RuntimeError(
+                    f"env workers {stuck} did not reply within "
+                    f"{self.step_timeout_s:.0f}s (wedged worker?)")
+            for conn in ready:
+                i = remaining.pop(conn)
+                try:
+                    kind, payload = conn.recv()
+                except (EOFError, ConnectionResetError, OSError):
+                    exitcode = self._procs[i].exitcode
+                    self.close()
+                    raise RuntimeError(
+                        f"env worker {i} died mid-step (exitcode "
+                        f"{exitcode})") from None
+                if kind == "error":
+                    self.close()
+                    raise RuntimeError(f"env worker failed:\n{payload}")
+                on_reply(i, payload)
+
+    # ---------------------------------------------------------- shm plumbing
+    def _setup_slabs(self, obs: List[Dict[str, np.ndarray]]) -> None:
+        """First-reset slab allocation: field shapes/dtypes come from the
+        first worker's obs (all workers must agree — i.e. the env pads to
+        fixed bounds); on any failure the env falls back to pipe
+        permanently rather than crash training."""
+        from ddls_tpu.rl import shm as shm_mod
+
+        try:
+            fields = shm_mod.obs_field_specs(obs[0], OBS_KEYS)
+            for j, o in enumerate(obs[1:], start=1):
+                other = shm_mod.obs_field_specs(o, OBS_KEYS)
+                if other != fields:
+                    raise ValueError(
+                        f"env {j} obs shapes {other} differ from env 0's "
+                        f"{fields} (shm needs fixed pad bounds)")
+            slabs = shm_mod.SlabSet(fields, rows=1, num_envs=self.num_envs)
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"shm backend unusable for this env ({e}); "
+                          "falling back to pipe")
+            self.backend = "pipe"
+            return
+        self._field_specs = fields
+        self._install_slabs(slabs)
+        # non-slab obs fields (action_set) are episode-constant; captured
+        # at reset and reattached to materialised obs copies
+        self._extra_obs = [{k: np.asarray(v) for k, v in o.items()
+                            if k not in OBS_KEYS} for o in obs]
+
+    def _install_slabs(self, slabs) -> None:
+        """Broadcast the slab spec and wait for every worker's attach ack
+        (after which step replies stop carrying obs payloads)."""
+        with telemetry.span("rollout.shm.setup"):
+            spec = slabs.spec()
+            for i in range(self.num_envs):
+                self._send(i, ("shm_open", spec))
+            for conn in self._conns:
+                self._recv(conn)
+        self._slabs = slabs
+        self._cur_row = 0
+        self._obs_nbytes = slabs.obs_nbytes
+
+    def _write_row0(self, obs: List[Dict[str, np.ndarray]]) -> None:
+        views = self._slabs.views
+        for k in OBS_KEYS:
+            for i in range(self.num_envs):
+                views[k][0, i] = obs[i][k]
+        self._cur_row = 0
+
+    def ensure_traj_rows(self, rows: int) -> bool:
+        """Grow the obs slabs to ``[rows, B, ...]`` so a [T, B] collector
+        can treat rows ``[0:T]`` as its trajectory buffer (row t = the obs
+        BEFORE step t; the final row = the bootstrap obs). Returns True
+        when the slab-trajectory contract is in force. No-op (False) on
+        the pipe backend."""
+        if self._slabs is None:
+            return False
+        if self._slabs.rows >= rows:
+            return True
+        current = self.obs  # materialise from the OLD slab first
+        old = self._slabs
+        try:
+            from ddls_tpu.rl.shm import SlabSet
+
+            slabs = SlabSet(self._field_specs, rows=rows,
+                            num_envs=self.num_envs)
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"could not grow shm slabs to {rows} rows "
+                          f"({e}); keeping per-step slab")
+            return False
+        self._install_slabs(slabs)
+        self._write_row0(current)
+        self._obs_cache = current
+        old.close()
+        return True
+
+    def rebase_row0(self) -> None:
+        """Move the current obs to slab row 0 (one [B, ...] copy per
+        field, once per segment) so the next T steps write rows 1..T."""
+        if self._slabs is None or self._cur_row == 0:
+            return
+        views = self._slabs.views
+        for k in OBS_KEYS:
+            views[k][0] = views[k][self._cur_row]
+        self._cur_row = 0
+        self._obs_cache = None
+
+    def traj_obs_views(self, T: int) -> Dict[str, np.ndarray]:
+        """Slab rows [0:T] as the trajectory obs — zero-copy views, valid
+        until the next ``rebase_row0``/``reset`` overwrites row 0 (i.e.
+        until the next collect segment begins)."""
+        return {k: self._slabs.views[k][:T] for k in OBS_KEYS}
 
     def reset(self) -> List[Dict[str, np.ndarray]]:
         # seeds live worker-side (advanced on every auto-reset); only the
@@ -251,24 +560,77 @@ class ParallelVectorEnv:
         payload = self.seeds if self._first_reset else [None] * self.num_envs
         self._first_reset = False
         self._stacked_cache = None
-        for conn, seed in zip(self._conns, payload):
-            conn.send(("reset", seed))
-        self.obs = [self._recv(conn)[1] for conn in self._conns]
+        for i, seed in enumerate(payload):
+            self._send(i, ("reset", seed))
+        obs = [self._recv(conn)[1] for conn in self._conns]
+        self.obs = obs
+        if self.backend == "shm" and self._slabs is None:
+            self._setup_slabs(obs)
+        if self._slabs is not None:
+            self._write_row0(obs)
+            self._obs_cache = obs
+        if not self._obs_nbytes:
+            # per-env obs bytes (the unit of the bytes-copied counters),
+            # valid for both transports once shapes are known
+            self._obs_nbytes = sum(int(np.asarray(obs[0][k]).nbytes)
+                                   for k in OBS_KEYS)
         return self.obs
 
     def stacked_obs(self) -> Dict[str, np.ndarray]:
-        """The current obs as one [B, ...] batch; with
-        ``prefetch_stacked`` the batch was already assembled inside the
+        """The current obs as one [B, ...] batch. Shm backend: VIEWS of
+        the slab row the workers wrote in place — no copy at all (valid
+        until the next ``step``/``reset``). Pipe backend with
+        ``prefetch_stacked``: the batch was already assembled inside the
         previous ``step()`` as worker replies arrived (bit-identical to
         ``stack_obs(self.obs)``, measured earlier)."""
+        if self._slabs is not None:
+            row = self._cur_row
+            return {k: self._slabs.views[k][row] for k in OBS_KEYS}
         if self._stacked_cache is not None:
             return self._stacked_cache
-        return stack_obs(self.obs)
+        stacked = stack_obs(self.obs)
+        if telemetry.enabled():
+            telemetry.inc("rollout.obs.bytes_stack",
+                          sum(v.nbytes for v in stacked.values()))
+        return stacked
 
     def step(self, actions: np.ndarray):
+        if self._slabs is not None:
+            return self._step_shm(actions)
         if self.prefetch_stacked:
             return self._step_prefetch(actions)
         return self.step_subset(range(self.num_envs), actions)
+
+    def _step_shm(self, actions: np.ndarray):
+        """Full-batch step over the slab transport: obs rows are written
+        worker-side (each write is the ONLY materialisation of that obs),
+        replies carry (reward, done, record) and arrive out of order —
+        the reply is the per-worker ready flag; episode records flush in
+        env-index order, matching the pipe paths bit-for-bit."""
+        R = self._slabs.rows
+        dest = self._cur_row if R == 1 else min(self._cur_row + 1, R - 1)
+        for i in range(self.num_envs):
+            self._send(i, ("step", (int(actions[i]), dest)))
+        B = self.num_envs
+        rewards = np.zeros(B, dtype=np.float32)
+        dones = np.zeros(B, dtype=bool)
+        records: Dict[int, dict] = {}
+
+        def on_reply(i, payload):
+            reward, done, record = payload
+            rewards[i] = reward
+            dones[i] = done
+            if record is not None:
+                records[i] = record
+
+        self._drain_step_replies(on_reply)
+        self._cur_row = dest
+        self._obs_cache = None
+        self.completed_episodes.extend(records[i] for i in sorted(records))
+        if telemetry.enabled():
+            telemetry.inc("rollout.ipc.replies", B)
+            telemetry.inc("rollout.obs.bytes_slab", self._obs_nbytes * B)
+        return _LazyObsList(self), rewards, dones
 
     def _step_prefetch(self, actions: np.ndarray):
         """Full-batch step with out-of-order reply handling: each worker's
@@ -276,56 +638,77 @@ class ParallelVectorEnv:
         stacking overlaps the stragglers' env stepping. Outputs (obs,
         rewards, dones, episode-record order) are bit-identical to the
         in-order path — records are flushed in env-index order."""
-        from multiprocessing import connection as mp_connection
-
-        for i, conn in enumerate(self._conns):
-            conn.send(("step", int(actions[i])))
+        for i in range(self.num_envs):
+            self._send(i, ("step", int(actions[i])))
         B = self.num_envs
         rewards = np.zeros(B, dtype=np.float32)
         dones = np.zeros(B, dtype=bool)
-        stacked: Optional[Dict[str, np.ndarray]] = None
         records: Dict[int, dict] = {}
-        remaining = {conn: i for i, conn in enumerate(self._conns)}
-        while remaining:
-            for conn in mp_connection.wait(list(remaining)):
-                i = remaining.pop(conn)
-                kind, payload = conn.recv()
-                if kind == "error":
-                    self.close()
-                    raise RuntimeError(f"env worker failed:\n{payload}")
-                obs, reward, done, record = payload
-                self.obs[i] = obs
-                if stacked is None:
+        state = {"stacked": None}
+
+        def on_reply(i, payload):
+            obs, reward, done, record = payload
+            self.obs[i] = obs
+            stacked = state["stacked"]
+            if stacked is None:
+                # reuse the previous step's assembly buffers (valid-
+                # until-next-step contract, same as stacked_obs)
+                stacked = self._stacked_bufs
+                if stacked is None or any(
+                        stacked[k].shape[1:] != np.asarray(obs[k]).shape
+                        or stacked[k].dtype != np.asarray(obs[k]).dtype
+                        for k in OBS_KEYS):
                     stacked = {
                         k: np.empty((B,) + np.asarray(obs[k]).shape,
                                     np.asarray(obs[k]).dtype)
                         for k in OBS_KEYS}
-                for k in OBS_KEYS:
-                    stacked[k][i] = obs[k]
-                rewards[i] = reward
-                dones[i] = done
-                if record is not None:
-                    records[i] = record
+                self._stacked_bufs = state["stacked"] = stacked
+            for k in OBS_KEYS:
+                stacked[k][i] = obs[k]
+            rewards[i] = reward
+            dones[i] = done
+            if record is not None:
+                records[i] = record
+
+        self._drain_step_replies(on_reply)
         self.completed_episodes.extend(
             records[i] for i in sorted(records))
-        self._stacked_cache = stacked
+        self._stacked_cache = state["stacked"]
+        if telemetry.enabled():
+            telemetry.inc("rollout.ipc.replies", B)
+            telemetry.inc("rollout.obs.bytes_pipe", self._obs_nbytes * B)
+            telemetry.inc("rollout.obs.bytes_stack", self._obs_nbytes * B)
         return list(self.obs), rewards, dones
 
     def step_subset(self, indices, actions: np.ndarray):
-        """Step only the workers in ``indices``; see VectorEnv.step_subset."""
+        """Step only the workers in ``indices``; see VectorEnv.step_subset.
+        On the shm backend a partial subset rides the pipe (obs payload)
+        and the parent refreshes the CURRENT slab row in place — subset
+        stepping is the split-batch pipelined collector's path, which
+        never runs under the slab-trajectory contract."""
         indices = list(indices)
         self._stacked_cache = None
         for k, i in enumerate(indices):
-            self._conns[i].send(("step", int(actions[k])))
+            self._send(i, ("step", int(actions[k])))
         rewards = np.zeros(len(indices), dtype=np.float32)
         dones = np.zeros(len(indices), dtype=bool)
         for k, i in enumerate(indices):
             _, (obs, reward, done, record) = self._recv(self._conns[i])
-            self.obs[i] = obs
+            if self._slabs is not None:
+                views = self._slabs.views
+                for key in OBS_KEYS:
+                    views[key][self._cur_row, i] = obs[key]
+                self._obs_cache = None
+            else:
+                self.obs[i] = obs
             rewards[k] = reward
             dones[k] = done
             if record is not None:
                 self.completed_episodes.append(record)
+        if telemetry.enabled():
+            telemetry.inc("rollout.ipc.replies", len(indices))
+            telemetry.inc("rollout.obs.bytes_pipe",
+                          self._obs_nbytes * len(indices))
         return [self.obs[i] for i in indices], rewards, dones
 
     def drain_completed_episodes(self) -> List[Dict[str, Any]]:
@@ -338,12 +721,24 @@ class ParallelVectorEnv:
         if self._first_reset:
             return self.reset()
         self._stacked_cache = None
-        for conn in self._conns:
-            conn.send(("restart", None))
-        self.obs = [self._recv(conn)[1] for conn in self._conns]
+        for i in range(self.num_envs):
+            self._send(i, ("restart", None))
+        obs = [self._recv(conn)[1] for conn in self._conns]
+        self.obs = obs
+        if self._slabs is not None:
+            self._write_row0(obs)
+            self._obs_cache = obs
         return self.obs
 
     def close(self) -> None:
+        """Idempotent shutdown: close acks drained under one shared
+        deadline, workers join-escalated (join -> terminate -> kill) so a
+        wedged worker can never hang teardown, and the shm slabs are
+        unlinked last (their finalizer covers paths that never reach
+        here)."""
+        if self._closed:
+            return
+        self._closed = True
         for conn in self._conns:
             try:
                 conn.send(("close", None))
@@ -374,6 +769,13 @@ class ParallelVectorEnv:
             proc.join(timeout=5)
             if proc.is_alive():
                 proc.terminate()
+                proc.join(timeout=2)
+            if proc.is_alive():  # terminate ignored (blocked in syscall)
+                proc.kill()
+                proc.join(timeout=1)
+        if self._slabs is not None:
+            self._slabs.close()
+            self._slabs = None
 
 
 class RolloutCollector:
@@ -456,9 +858,29 @@ class RolloutCollector:
 
     def _collect_deferred(self, params, rng) -> Dict[str, Any]:
         """Deferred-fetch collection (see __init__); [T, B] outputs
-        bit-identical to the plain path."""
+        bit-identical to the plain path.
+
+        On a shm-backend vec env the slabs grow to [T+1, B, ...] and the
+        workers' in-place writes ARE the trajectory buffer (row t = the
+        obs before step t, row T = the bootstrap obs): the T per-step
+        host-side copies collapse to ONE bulk memcpy of rows [0:T] into
+        a FRESH buffer at segment end. The copy is a correctness
+        requirement, not a convenience: jax's CPU client ZERO-COPY
+        ALIASES page-aligned host buffers (shm mmaps are page-aligned)
+        when a device_put/jit input needs no layout change — measured
+        here on a 1-device mesh — so slab views staged into the async
+        update would be silently rewritten by the next segment's worker
+        writes. A fresh never-rewritten buffer makes aliasing harmless
+        (jax holds the reference); the per-step sample inputs may stay
+        views because each step's ``device_get(actions)`` completes the
+        forward before any row it read is rewritten."""
         T, B = self.rollout_length, self.vec_env.num_envs
         step_fn = self._step_program()
+        ensure = getattr(self.vec_env, "ensure_traj_rows", None)
+        use_slab = bool(ensure is not None and ensure(T + 1))
+        if use_slab:
+            # carry the previous segment's bootstrap obs into row 0
+            self.vec_env.rebase_row0()
         if self._obs_sharding is not None:
             # the epoch's incoming key was split outside the mesh; place
             # it next to the params explicitly (after step 0 the key is
@@ -475,14 +897,19 @@ class RolloutCollector:
             staged = (jax.device_put(batched, self._obs_sharding)
                       if self._obs_sharding is not None else batched)
             rng, actions, logp, values = step_fn(params, staged, rng)
-            if traj_obs is None:
-                traj_obs = {k: np.empty((T,) + batched[k].shape,
-                                        batched[k].dtype)
-                            for k in OBS_KEYS}
-            # the copy into the traj buffers runs while the device is
-            # still computing this step's forward
-            for k in OBS_KEYS:
-                traj_obs[k][t] = batched[k]
+            if not use_slab:
+                if traj_obs is None:
+                    traj_obs = {k: np.empty((T,) + batched[k].shape,
+                                            batched[k].dtype)
+                                for k in OBS_KEYS}
+                # the copy into the traj buffers runs while the device is
+                # still computing this step's forward
+                for k in OBS_KEYS:
+                    traj_obs[k][t] = batched[k]
+                if telemetry.enabled():
+                    telemetry.inc("rollout.obs.bytes_traj_copy",
+                                  sum(np.asarray(batched[k]).nbytes
+                                      for k in OBS_KEYS))
             actions = jax.device_get(actions)
             act_buf[t] = actions
             logp_refs[t] = logp
@@ -490,6 +917,15 @@ class RolloutCollector:
             _, rewards, dones = self.vec_env.step(actions)
             rew_buf[t] = rewards
             done_buf[t] = dones
+        if use_slab:
+            # one bulk memcpy of the worker-written slab rows into a
+            # fresh buffer (see docstring: staging must never alias the
+            # reused slab); np.array allocates + copies in one call
+            views = self.vec_env.traj_obs_views(T)
+            traj_obs = {k: np.array(v) for k, v in views.items()}
+            if telemetry.enabled():
+                telemetry.inc("rollout.obs.bytes_traj_copy",
+                              sum(v.nbytes for v in traj_obs.values()))
         final = self.vec_env.stacked_obs()
         final_staged = (jax.device_put(final, self._obs_sharding)
                         if self._obs_sharding is not None else final)

@@ -52,9 +52,20 @@ class ObsBucketer:
     Requests larger than every bucket raise ``BucketOverflowError`` — the
     server answers those from the heuristic fallback rather than compiling
     an unbounded program on demand.
+
+    ``reuse_arenas``: recycle per-bucket destination arrays (the
+    ``pad_obs_to(out=...)`` encode-into-destination API) instead of
+    allocating a fresh padded obs per request — bit-identical output
+    (pinned with the per-bucket equality tests in tests/test_serve.py).
+    The caller then OWNS the lease discipline: each ``bucket_obs`` result
+    aliases one arena until ``release(idx, obs)`` returns it to the pool,
+    so release only after the request leaves the microbatch queue and its
+    batch is resolved (PolicyServer does this at the end of each flush).
     """
 
-    def __init__(self, buckets: Sequence[BucketSpec]):
+    def __init__(self, buckets: Sequence[BucketSpec],
+                 reuse_arenas: bool = False,
+                 max_pool_per_bucket: int = 64):
         if not buckets:
             raise ValueError("need at least one bucket")
         self.buckets: List[BucketSpec] = sorted(
@@ -62,6 +73,10 @@ class ObsBucketer:
         for n, e in self.buckets:
             if n < 1 or e < 1:
                 raise ValueError(f"bucket ({n}, {e}) must be positive")
+        self.reuse_arenas = bool(reuse_arenas)
+        self.max_pool_per_bucket = int(max_pool_per_bucket)
+        self._pools: List[List[Dict[str, np.ndarray]]] = [
+            [] for _ in self.buckets]
 
     def bucket_index(self, n_nodes: int, n_edges: int) -> int:
         for i, (bn, be) in enumerate(self.buckets):
@@ -71,6 +86,55 @@ class ObsBucketer:
             f"graph with {n_nodes} ops / {n_edges} deps exceeds every "
             f"bucket {self.buckets}")
 
+    def _new_arena(self, idx: int,
+                   obs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Destination arrays for one request in bucket ``idx``: padded
+        fields at the bucket bounds, passthrough fields (graph_features,
+        action_mask, action_set, ...) shaped/typed from this obs."""
+        bn, be = self.buckets[idx]
+        arena: Dict[str, np.ndarray] = {
+            "node_features": np.zeros((bn, np.asarray(
+                obs["node_features"]).shape[1]), np.float32),
+            "edge_features": np.zeros((be, np.asarray(
+                obs["edge_features"]).shape[1]), np.float32),
+            "edges_src": np.zeros(be, np.int32),
+            "edges_dst": np.zeros(be, np.int32),
+            "node_split": np.zeros(1, np.int32),
+            "edge_split": np.zeros(1, np.int32),
+        }
+        for key, val in obs.items():
+            if key not in arena:
+                val = np.asarray(val)
+                arena[key] = np.empty(val.shape, val.dtype)
+        return arena
+
+    def _arena_fits(self, arena: Dict[str, np.ndarray],
+                    obs: Dict[str, np.ndarray]) -> bool:
+        """Passthrough fields must match this obs exactly — BOTH ways:
+        every obs extra must have a matching arena array, and the arena
+        must carry no key this obs lacks (``pad_obs_to(out=)`` copies
+        every ``out`` entry from the obs, so a stale extra key from a
+        previous occupant would KeyError mid-request). A mismatched
+        client simply gets a fresh arena rather than a crash or a
+        silent cast; widths are config-constant in practice."""
+        if set(arena) != set(obs):
+            return False
+        for key in ("node_features", "edge_features"):
+            # feature WIDTH rides the client obs (the server pins it at
+            # submit; standalone callers may vary) — row counts are the
+            # bucket's own and always match within a pool
+            if arena[key].shape[1] != np.asarray(obs[key]).shape[1]:
+                return False
+        for key, val in obs.items():
+            if key in ("node_features", "edge_features", "edges_src",
+                       "edges_dst", "node_split", "edge_split"):
+                continue
+            dst = arena[key]
+            val = np.asarray(val)
+            if dst.shape != val.shape or dst.dtype != val.dtype:
+                return False
+        return True
+
     def bucket_obs(self, obs: Dict[str, np.ndarray]
                    ) -> Tuple[int, Dict[str, np.ndarray]]:
         """Pick the smallest fitting bucket and re-pad the obs into it."""
@@ -78,7 +142,24 @@ class ObsBucketer:
         m = int(np.asarray(obs["edge_split"]).reshape(-1)[0])
         idx = self.bucket_index(n, m)
         bn, be = self.buckets[idx]
-        return idx, pad_obs_to(obs, bn, be)
+        if not self.reuse_arenas:
+            return idx, pad_obs_to(obs, bn, be)
+        pool = self._pools[idx]
+        arena = pool.pop() if pool else self._new_arena(idx, obs)
+        if not self._arena_fits(arena, obs):
+            arena = self._new_arena(idx, obs)
+        return idx, pad_obs_to(obs, bn, be, out=arena)
+
+    def release(self, idx: int, obs: Dict[str, np.ndarray]) -> None:
+        """Return a ``bucket_obs`` result's arena to bucket ``idx``'s
+        pool once nothing references its arrays any more. No-op unless
+        ``reuse_arenas``; the pool is bounded so a queue burst can never
+        pin unbounded memory."""
+        if not self.reuse_arenas or obs is None:
+            return
+        pool = self._pools[idx]
+        if len(pool) < self.max_pool_per_bucket:
+            pool.append(obs)
 
 
 class BucketOverflowError(ValueError):

@@ -339,6 +339,7 @@ class BucketForward:
         raw = apply_fn or (lambda p, o: batched_policy_apply(model, p, o))
         self._jit = jax.jit(raw)
         self._compiled_shapes: set = set()
+        self._stack_bufs: Dict[tuple, Dict[str, np.ndarray]] = {}
 
     @property
     def n_compiles(self) -> int:
@@ -348,7 +349,14 @@ class BucketForward:
               ) -> Tuple[Dict[str, np.ndarray], int]:
         """Host-side batch assembly, separated from the device call so the
         server can tell malformed request DATA (stack fails here) apart
-        from a dead device BACKEND (run fails below)."""
+        from a dead device BACKEND (run fails below). The stacked batch
+        is assembled into a per-shape REUSED buffer, so steady-state
+        flushes allocate nothing. Reuse is safe because ``run`` DRAINS
+        the forward (``jax.device_get``) before returning, and the next
+        ``stack`` cannot happen until then — NOT because jax copies the
+        input: its CPU client zero-copy ALIASES page-aligned host
+        buffers (rl/rollout.py round-7 discovery), so making ``run``
+        async would require a fresh buffer per flush."""
         if not obs_list:
             raise ValueError("empty batch")
         if len(obs_list) > self.max_batch:
@@ -356,10 +364,19 @@ class BucketForward:
                              f"{self.max_batch}")
         n_real = len(obs_list)
         filled = list(obs_list) + [obs_list[0]] * (self.max_batch - n_real)
-        stacked = {k: np.stack([np.asarray(o[k]) for o in filled])
-                   for k in ("node_features", "edge_features",
-                             "graph_features", "edges_src", "edges_dst",
-                             "node_split", "edge_split", "action_mask")}
+        arrays = {k: [np.asarray(o[k]) for o in filled]
+                  for k in ("node_features", "edge_features",
+                            "graph_features", "edges_src", "edges_dst",
+                            "node_split", "edge_split", "action_mask")}
+        shape_key = tuple(sorted((k, v[0].shape, str(v[0].dtype))
+                                 for k, v in arrays.items()))
+        stacked = self._stack_bufs.get(shape_key)
+        if stacked is None:
+            stacked = {k: np.empty((self.max_batch,) + v[0].shape,
+                                   v[0].dtype) for k, v in arrays.items()}
+            self._stack_bufs[shape_key] = stacked
+        for k, v in arrays.items():
+            np.stack(v, out=stacked[k])
         return stacked, n_real
 
     def run(self, stacked: Dict[str, np.ndarray], n_real: int
@@ -411,9 +428,14 @@ class PolicyServer:
                  graph_feature_dim: Optional[int] = None,
                  apply_fn: Optional[Callable] = None,
                  clock: Callable[[], float] = time.perf_counter):
+        # arena reuse: bucketed obs land in recycled per-bucket arrays
+        # (pad_obs_to out=); leases are released at the end of each
+        # flush in _run_batch, after the batch (or its fallback) is
+        # fully resolved — the pool bound tracks the queue budget
         self.bucketer = ObsBucketer(
             buckets if buckets is not None
-            else default_buckets(max_nodes, max_edges))
+            else default_buckets(max_nodes, max_edges),
+            reuse_arenas=True, max_pool_per_bucket=max(int(max_queue), 1))
         self.engine = MicrobatchEngine(len(self.bucketer.buckets),
                                        max_batch=max_batch,
                                        deadline_s=deadline_s,
@@ -520,6 +542,19 @@ class PolicyServer:
     def _run_batch(self, bucket_idx: int, reqs: List[PendingRequest],
                    now: float, reread_clock: bool = True,
                    force: bool = False) -> None:
+        try:
+            self._run_batch_inner(bucket_idx, reqs, now, reread_clock,
+                                  force)
+        finally:
+            # every path below is done with the bucketed obs (policy
+            # answers read only logits; fallback answers resolve
+            # synchronously inside), so the arenas recycle here
+            for r in reqs:
+                self.bucketer.release(bucket_idx, r.obs)
+
+    def _run_batch_inner(self, bucket_idx: int, reqs: List[PendingRequest],
+                         now: float, reread_clock: bool = True,
+                         force: bool = False) -> None:
         # flush-cause attribution: a full batch always means fill (the
         # engine pops full batches before deadline/force partials)
         cause = ("fill" if len(reqs) >= self.engine.max_batch

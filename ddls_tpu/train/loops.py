@@ -231,6 +231,7 @@ class RLEpochLoop:
                  loop_mode: str = "pipelined",
                  metrics_sync_interval: int = 10,
                  pipeline_depth: int = 0,
+                 vec_env_backend: str = "auto",
                  path_to_model_cls: Optional[str] = None,  # config parity
                  **kwargs):
         import jax
@@ -268,6 +269,15 @@ class RLEpochLoop:
         if self.pipeline_depth and self.loop_mode != "pipelined":
             raise ValueError(
                 "pipeline_depth > 0 requires loop_mode='pipelined'")
+        if vec_env_backend not in ("auto", "pipe", "shm"):
+            raise ValueError(
+                f"vec_env_backend must be 'auto', 'pipe' or 'shm', got "
+                f"{vec_env_backend!r}")
+        # subprocess obs transport (rl/rollout.py): 'auto' = zero-copy
+        # shared-memory slabs where POSIX shm is usable, pipe otherwise;
+        # bit-exact either way (tests/test_shm.py pins pipe==shm params/
+        # episodes), so the default favours the cheaper transport
+        self.vec_env_backend = vec_env_backend
         # pipelining runtime state: the prefetched (out, straj, slv)
         # future, the unsynced-metrics ring, and the lazily-created
         # executors (collection thread / device-update watcher)
@@ -309,7 +319,8 @@ class RLEpochLoop:
             self.vec_env = ParallelVectorEnv(
                 self.env_cls, self.env_config, self.num_envs,
                 seeds=[self._collect_seed + i
-                       for i in range(self.num_envs)])
+                       for i in range(self.num_envs)],
+                backend=self.vec_env_backend)
         else:
             self.vec_env = VectorEnv(
                 [lambda: self.env_cls(**self.env_config)

@@ -1,0 +1,588 @@
+"""Zero-copy shared-memory rollout collection pins (docs/perf_round7.md).
+
+* ``pad_obs_to(..., out=)`` / ``write_obs_into`` — the encode-into-
+  destination API is bit-identical to the allocating path (fuzzed over
+  random graph sizes/dtypes, mask rows included);
+* pipe-vs-shm backend parity — same stacked obs, rewards/dones, episode
+  records (content AND order) stepping the same seeds, and bit-exact
+  post-training params for PPO and IMPALA epoch loops on the virtual
+  CPU mesh (the full-collect acceptance pin);
+* slab-trajectory contract — the deferred-fetch collector's traj rows
+  ARE the slab (row t = obs before step t);
+* lifecycle hardening — a killed worker raises a clear error instead of
+  hanging, ``close()`` is idempotent, and no ``/dev/shm`` segment
+  outlives the env (kill path included);
+* ``scripts/check_shm_unlink.py`` tier-1 guard (clean tree passes, a
+  synthetic unpaired create is flagged);
+* serve arena reuse — ``ObsBucketer(reuse_arenas=True)`` output equals
+  the allocating bucketer and recycles released arenas.
+
+Tests needing real POSIX shared memory carry the ``shm`` marker
+(conftest auto-skips them where /dev/shm is unavailable).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_ACTIONS = 5
+MAX_N, MAX_E = 6, 15
+
+
+class ZeroPadToyEnv:
+    """3-step episodes with encoder-faithful observations: fixed padded
+    shapes and ZERO dead-pad bytes, exactly what ``envs/obs.py`` encode
+    emits — so pipe and shm transports agree bit-for-bit (the shm write
+    normalises the dead region through the masked-pad policy)."""
+
+    def __init__(self):
+        self.t = 0
+        self.base = 0
+
+    def reset(self, seed=None):
+        self.t = 0
+        self.base = 0 if seed is None else int(seed)
+        return self._obs()
+
+    def _obs(self):
+        rng = np.random.RandomState(self.base * 977 + self.t)
+        n, m = 4, 3
+        obs = {
+            "node_features": np.zeros((MAX_N, 5), np.float32),
+            "edge_features": np.zeros((MAX_E, 2), np.float32),
+            "graph_features": rng.rand(17 + N_ACTIONS).astype(np.float32),
+            "edges_src": np.zeros(MAX_E, np.int32),
+            "edges_dst": np.zeros(MAX_E, np.int32),
+            "node_split": np.array([n], np.int32),
+            "edge_split": np.array([m], np.int32),
+            "action_mask": np.ones(N_ACTIONS, np.int32),
+            "action_set": np.arange(N_ACTIONS, dtype=np.int32),
+        }
+        obs["node_features"][:n] = rng.rand(n, 5)
+        obs["edge_features"][:m] = rng.rand(m, 2)
+        obs["edges_src"][:m] = rng.randint(0, n, m)
+        obs["edges_dst"][:m] = rng.randint(0, n, m)
+        return obs
+
+    def step(self, action):
+        self.t += 1
+        done = self.t % 3 == 0
+        return self._obs(), float(1 + int(action)), done, {}
+
+
+def _random_encoded_obs(rng, pad_n, pad_e, src_dtype=np.int32):
+    """A random encoded-contract obs padded to (pad_n, pad_e); the dead
+    region carries GARBAGE on purpose — pad_obs_to must mask it out
+    identically on both paths."""
+    n = int(rng.randint(1, pad_n + 1))
+    m = int(rng.randint(0, pad_e + 1))
+    obs = {
+        "node_features": rng.rand(pad_n, 5).astype(np.float32),
+        "edge_features": rng.rand(pad_e, 2).astype(np.float32),
+        "graph_features": rng.rand(22).astype(np.float32),
+        "edges_src": rng.randint(0, n, pad_e).astype(src_dtype),
+        "edges_dst": rng.randint(0, n, pad_e).astype(src_dtype),
+        "node_split": np.array([n], np.int32),
+        "edge_split": np.array([m], np.int32),
+        "action_mask": rng.randint(0, 2, N_ACTIONS).astype(np.int32),
+        "action_set": np.arange(N_ACTIONS, dtype=np.int32),
+    }
+    return obs, n, m
+
+
+# ------------------------------------------------- encode-into-destination
+def test_pad_obs_to_out_fuzz():
+    """out= writes must equal the allocating path EXACTLY — every key,
+    every dtype, dead/mask rows included — over random sizes, source
+    dtypes, and stale destination contents."""
+    from ddls_tpu.envs.obs import pad_obs_to
+
+    rng = np.random.RandomState(0)
+    for trial in range(40):
+        pad_n = int(rng.randint(2, 12))
+        pad_e = int(rng.randint(1, 20))
+        src_dtype = [np.int32, np.int64][trial % 2]
+        obs, n, m = _random_encoded_obs(rng, pad_n, pad_e, src_dtype)
+        to_n = int(rng.randint(n, n + 8))
+        to_e = int(rng.randint(m, m + 12))
+        ref = pad_obs_to(obs, to_n, to_e)
+        # destinations pre-filled with garbage: the masked-pad write must
+        # zero the dead region, not inherit stale bytes
+        out = {
+            "node_features": rng.rand(to_n, 5).astype(np.float32),
+            "edge_features": rng.rand(to_e, 2).astype(np.float32),
+            "edges_src": rng.randint(0, 99, to_e).astype(np.int32),
+            "edges_dst": rng.randint(0, 99, to_e).astype(np.int32),
+            "node_split": np.array([77], np.int32),
+            "edge_split": np.array([77], np.int32),
+            "graph_features": np.empty(22, np.float32),
+            "action_mask": np.empty(N_ACTIONS, np.int32),
+            "action_set": np.empty(N_ACTIONS, np.int32),
+        }
+        got = pad_obs_to(obs, to_n, to_e, out=out)
+        assert set(got) == set(ref)
+        for k in ref:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(ref[k]), err_msg=k)
+            assert np.asarray(got[k]).dtype == np.asarray(ref[k]).dtype, k
+        # the written fields alias the caller's arrays (that is the point)
+        assert got["node_features"] is out["node_features"]
+
+
+def test_pad_obs_to_out_rejects_mismatched_rows():
+    from ddls_tpu.envs.obs import pad_obs_to
+
+    rng = np.random.RandomState(1)
+    obs, n, m = _random_encoded_obs(rng, 6, 10)
+    out = {"node_features": np.zeros((4, 5), np.float32),
+           "edge_features": np.zeros((12, 2), np.float32),
+           "edges_src": np.zeros(12, np.int32),
+           "edges_dst": np.zeros(12, np.int32),
+           "node_split": np.zeros(1, np.int32),
+           "edge_split": np.zeros(1, np.int32)}
+    with pytest.raises(ValueError, match="rows"):
+        pad_obs_to(obs, 8, 12, out=out)  # node dest has 4 rows, target 8
+
+
+def test_write_obs_into_and_writer_roundtrip():
+    """write_obs_into infers the pad target from the destination; the
+    result reproduces the source obs bit-for-bit when shapes match (the
+    worker-slab write) because encode's own pad region is zero."""
+    from ddls_tpu.envs.obs import ObsWriter, write_obs_into
+
+    env = ZeroPadToyEnv()
+    obs = env.reset(seed=3)
+    out = {k: np.empty_like(np.asarray(v)) for k, v in obs.items()}
+    got = write_obs_into(obs, out)
+    for k in obs:
+        np.testing.assert_array_equal(np.asarray(got[k]),
+                                      np.asarray(obs[k]), err_msg=k)
+    writer = ObsWriter(MAX_N, MAX_E)
+    got2 = writer.write(obs, out)
+    for k in obs:
+        np.testing.assert_array_equal(np.asarray(got2[k]),
+                                      np.asarray(obs[k]), err_msg=k)
+
+
+# ------------------------------------------------ VectorEnv cached stacking
+def test_vector_env_stacked_obs_cached_buffer():
+    """The in-process stacked_obs reuses ONE preallocated buffer across
+    calls, bit-identical to stack_obs (the single-process half of the
+    copy tax)."""
+    from ddls_tpu.rl.rollout import VectorEnv, stack_obs
+
+    vec = VectorEnv([ZeroPadToyEnv for _ in range(3)])
+    vec.reset()
+    first = vec.stacked_obs()
+    ref = stack_obs(vec.obs)
+    for k in ref:
+        np.testing.assert_array_equal(first[k], ref[k], err_msg=k)
+    vec.step(np.zeros(3, np.int32))
+    second = vec.stacked_obs()
+    ref2 = stack_obs(vec.obs)
+    for k in ref2:
+        np.testing.assert_array_equal(second[k], ref2[k], err_msg=k)
+        assert second[k] is first[k], f"{k}: buffer not reused"
+    vec.close()
+
+
+# --------------------------------------------------- pipe-vs-shm stepping
+def _leaked(names):
+    return [n for n in names
+            if os.path.exists(os.path.join("/dev/shm", n.lstrip("/")))]
+
+
+@pytest.mark.shm
+def test_shm_backend_matches_pipe_stepping():
+    """Same seeds, same actions: stacked obs, per-env obs, rewards,
+    dones, and episode records (content and order) are bit-identical
+    across transports; slab segments unlink on close."""
+    from ddls_tpu.rl.rollout import ParallelVectorEnv
+
+    shm = ParallelVectorEnv(ZeroPadToyEnv, {}, 4, backend="shm")
+    pipe = ParallelVectorEnv(ZeroPadToyEnv, {}, 4, backend="pipe")
+    try:
+        shm.reset()
+        pipe.reset()
+        assert shm.backend == "shm" and shm._slabs is not None
+        names = list(shm._slabs.segment_names())
+        for t in range(8):
+            actions = np.arange(4, dtype=np.int32) % 3
+            obs_a, rew_a, done_a = shm.step(actions)
+            obs_b, rew_b, done_b = pipe.step(actions)
+            np.testing.assert_array_equal(rew_a, rew_b)
+            np.testing.assert_array_equal(done_a, done_b)
+            sa, sb = shm.stacked_obs(), pipe.stacked_obs()
+            for k in sb:
+                np.testing.assert_array_equal(sa[k], sb[k], err_msg=k)
+            for oa, ob in zip(obs_a, obs_b):
+                for k in ob:
+                    np.testing.assert_array_equal(
+                        np.asarray(oa[k]), np.asarray(ob[k]), err_msg=k)
+        assert (shm.drain_completed_episodes()
+                == pipe.drain_completed_episodes())
+        # a mid-run restart keeps both transports in lockstep
+        shm.restart_episodes()
+        pipe.restart_episodes()
+        sa, sb = shm.stacked_obs(), pipe.stacked_obs()
+        for k in sb:
+            np.testing.assert_array_equal(sa[k], sb[k], err_msg=k)
+    finally:
+        shm.close()
+        pipe.close()
+    assert not _leaked(names)
+
+
+@pytest.mark.shm
+def test_shm_traj_slab_rows_are_the_trajectory():
+    """ensure_traj_rows + rebase_row0: row t holds the obs BEFORE step t
+    and the final row holds the bootstrap obs — the deferred-fetch
+    collector's zero-copy trajectory contract."""
+    from ddls_tpu.rl.rollout import OBS_KEYS, ParallelVectorEnv
+
+    T = 5
+    vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="shm")
+    try:
+        vec.reset()
+        assert vec.ensure_traj_rows(T + 1)
+        assert vec.ensure_traj_rows(T + 1)  # idempotent fast path
+        for segment in range(2):
+            vec.rebase_row0()
+            expected = []
+            for t in range(T):
+                expected.append({k: np.copy(v) for k, v in
+                                 vec.stacked_obs().items()})
+                vec.step(np.zeros(2, np.int32))
+            final = {k: np.copy(v) for k, v in vec.stacked_obs().items()}
+            views = vec.traj_obs_views(T)
+            for t in range(T):
+                for k in OBS_KEYS:
+                    np.testing.assert_array_equal(
+                        views[k][t], expected[t][k],
+                        err_msg=f"segment {segment} row {t} {k}")
+            for k in OBS_KEYS:
+                np.testing.assert_array_equal(
+                    vec._slabs.views[k][T], final[k], err_msg=k)
+    finally:
+        vec.close()
+
+
+@pytest.mark.shm
+def test_deferred_collect_traj_never_aliases_the_slab():
+    """Regression pin for the zero-copy-aliasing hazard: jax's CPU
+    client zero-copy aliases page-aligned host buffers (shm mmaps are)
+    when no layout change is needed — e.g. on a 1-device mesh — so the
+    trajectory handed to the async update MUST be a fresh buffer, never
+    slab views, or the next segment's worker writes would rewrite the
+    update's training data in flight."""
+    import jax
+
+    from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
+    from ddls_tpu.parallel import make_mesh
+    from ddls_tpu.rl import PPOConfig, PPOLearner, RolloutCollector
+    from ddls_tpu.rl.rollout import OBS_KEYS, ParallelVectorEnv
+
+    vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="shm")
+    try:
+        vec.reset()
+        model = GNNPolicy(n_actions=N_ACTIONS)
+        obs0 = jax.tree_util.tree_map(np.asarray, vec.obs[0])
+        params = model.init(jax.random.PRNGKey(0), obs0)
+        learner = PPOLearner(
+            lambda p, o: batched_policy_apply(model, p, o),
+            PPOConfig(num_sgd_iter=1, sgd_minibatch_size=2,
+                      train_batch_size=8), make_mesh(1))
+        collector = RolloutCollector(vec, learner, rollout_length=4,
+                                     deferred_fetch=True)
+        collector._needs_reset = False
+        out = collector.collect(learner.init_state(params).params,
+                                jax.random.PRNGKey(1))
+        assert vec._slabs is not None and vec._slabs.rows == 5
+        snapshot = {k: np.copy(out["traj"]["obs"][k]) for k in OBS_KEYS}
+        for k in OBS_KEYS:
+            assert not np.shares_memory(out["traj"]["obs"][k],
+                                        vec._slabs.views[k]), k
+        # a second segment rewrites every slab row; the first segment's
+        # trajectory must not move
+        collector.collect(learner.init_state(params).params,
+                          jax.random.PRNGKey(2))
+        for k in OBS_KEYS:
+            np.testing.assert_array_equal(out["traj"]["obs"][k],
+                                          snapshot[k], err_msg=k)
+    finally:
+        vec.close()
+
+
+@pytest.mark.shm
+def test_killed_worker_raises_clear_error_and_unlinks():
+    """ISSUE 5 hardening pin: a worker killed mid-episode surfaces as a
+    RuntimeError naming the worker (never a hang), close() is
+    idempotent, and no segment survives in /dev/shm."""
+    from ddls_tpu.rl.rollout import ParallelVectorEnv
+
+    vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="shm")
+    vec.reset()
+    names = list(vec._slabs.segment_names())
+    vec.step(np.zeros(2, np.int32))
+    vec._procs[1].kill()
+    vec._procs[1].join(timeout=10)
+    with pytest.raises(RuntimeError, match="died"):
+        for _ in range(3):  # EOF lands on this or the next dispatch
+            vec.step(np.zeros(2, np.int32))
+    vec.close()  # idempotent after the error path's close
+    assert not _leaked(names)
+
+
+@pytest.mark.shm
+def test_slabset_finalizer_unlinks_without_close():
+    """Crash-path leak-proofing: a SlabSet that is garbage-collected (or
+    reaped at interpreter exit) unlinks its segments even though close()
+    never ran."""
+    import gc
+
+    from ddls_tpu.rl.shm import SlabSet
+
+    slabs = SlabSet({"x": ((3,), np.dtype(np.float32))}, rows=2,
+                    num_envs=2)
+    names = slabs.segment_names()
+    assert _leaked(names) == names  # alive while the set is
+    del slabs
+    gc.collect()
+    assert not _leaked(names)
+
+
+def test_backend_auto_falls_back_without_shm(monkeypatch):
+    """backend='auto' resolves to pipe when POSIX shm is unavailable
+    (the parity default on such platforms)."""
+    from ddls_tpu.rl import shm as shm_mod
+    from ddls_tpu.rl.rollout import ParallelVectorEnv
+
+    monkeypatch.setattr(shm_mod, "_AVAILABLE", False)
+    vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="auto")
+    try:
+        assert vec.backend == "pipe"
+        vec.reset()
+        vec.step(np.zeros(2, np.int32))
+    finally:
+        vec.close()
+
+
+def test_backend_rejects_unknown():
+    from ddls_tpu.rl.rollout import ParallelVectorEnv
+
+    with pytest.raises(ValueError, match="backend"):
+        ParallelVectorEnv(ZeroPadToyEnv, {}, 1, backend="carrier-pigeon")
+
+
+# --------------------------------------------- full-collect parity (loops)
+_TINY_MODEL = {"fcnet_hiddens": [16],
+               "custom_model_config": {"out_features_msg": 4,
+                                       "out_features_hidden": 8,
+                                       "out_features_node": 4,
+                                       "out_features_graph": 4}}
+
+ENV_CLS = "ddls_tpu.envs.partitioning_env.RampJobPartitioningEnvironment"
+
+
+def _env_config(dataset_dir):
+    return dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 100.0},
+            "replication_factor": 4,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 2},
+        max_partitions_per_op=4,
+        reward_function="job_acceptance",
+        max_simulation_run_time=5e4,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+
+
+@pytest.mark.shm
+@pytest.mark.parametrize("algo,algo_config", [
+    ("ppo", {"train_batch_size": 8, "sgd_minibatch_size": 4,
+             "num_sgd_iter": 2, "num_workers": 2}),
+    ("impala", {"lr": 1e-3, "train_batch_size": 8, "num_workers": 2}),
+], ids=["ppo", "impala"])
+def test_full_collect_parity_pipe_vs_shm(algo, algo_config, dataset_dir):
+    """The ISSUE 5 acceptance pin: identical post-training params,
+    episode records, and learner metrics for the same seeds under the
+    pipe and shm transports — the zero-copy restructure must not move a
+    single bit of the training math (pipelined loop = the deferred-fetch
+    collector riding the slab trajectory on the shm side)."""
+    import jax
+
+    from ddls_tpu.train import make_epoch_loop
+
+    outcomes = {}
+    for backend in ("pipe", "shm"):
+        loop = make_epoch_loop(
+            algo,
+            path_to_env_cls=ENV_CLS,
+            env_config=_env_config(dataset_dir),
+            model=_TINY_MODEL,
+            algo_config=dict(algo_config),
+            num_envs=2, rollout_length=4, n_devices=2,
+            use_parallel_envs=True, vec_env_backend=backend,
+            evaluation_interval=None, seed=0, loop_mode="pipelined")
+        assert loop.vec_env.backend == backend
+        records = []
+        for _ in range(2):
+            r = loop.run()
+            records.append({"learner": dict(r["learner"]),
+                            "episodes": r["episodes"],
+                            "env_steps": r["env_steps_this_iter"]})
+        loop.sync_metrics()
+        params = jax.device_get(loop.state.params)
+        loop.close()
+        outcomes[backend] = (records, params)
+
+    pipe_records, pipe_params = outcomes["pipe"]
+    shm_records, shm_params = outcomes["shm"]
+    for e, (rp, rs) in enumerate(zip(pipe_records, shm_records)):
+        assert rp["env_steps"] == rs["env_steps"], f"epoch {e}"
+        assert rp["learner"] == rs["learner"], f"epoch {e} metrics"
+        assert rp["episodes"] == rs["episodes"], f"epoch {e} episodes"
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        pipe_params, shm_params)
+
+
+@pytest.mark.shm
+def test_shm_epoch_stays_transfer_free(dataset_dir):
+    """The slab-trajectory epoch keeps the round-6 pin: a steady-state
+    collect→update epoch performs NO implicit device↔host transfer —
+    slab views enter the device only through the collector's explicit
+    device_put staging."""
+    import jax
+
+    from ddls_tpu.train import make_epoch_loop
+
+    loop = make_epoch_loop(
+        "ppo",
+        path_to_env_cls=ENV_CLS,
+        env_config=_env_config(dataset_dir),
+        model=_TINY_MODEL,
+        algo_config={"train_batch_size": 8, "sgd_minibatch_size": 4,
+                     "num_sgd_iter": 2, "num_workers": 2},
+        num_envs=2, rollout_length=4, n_devices=2,
+        use_parallel_envs=True, vec_env_backend="shm",
+        evaluation_interval=None, seed=0, loop_mode="pipelined",
+        metrics_sync_interval=1000)
+    try:
+        assert loop.vec_env.backend == "shm"
+        loop.run()  # warm epoch: compiles + first-use constant transfers
+        with jax.transfer_guard("disallow"):
+            r = loop.run()
+        assert np.isfinite(r["learner"]["total_loss"])
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------------- serve arena reuse
+def test_serve_bucketer_arena_reuse_bit_equal():
+    """reuse_arenas output equals the allocating bucketer for every
+    field, and a released arena is recycled for the next same-bucket
+    lease (no fresh allocation)."""
+    from ddls_tpu.serve.bucketing import ObsBucketer
+
+    buckets = [(4, 6), (8, 12)]
+    plain = ObsBucketer(buckets)
+    reuse = ObsBucketer(buckets, reuse_arenas=True)
+    rng = np.random.RandomState(7)
+    leased = []
+    for _ in range(6):
+        obs, n, m = _random_encoded_obs(rng, 8, 12)
+        i_p, padded_p = plain.bucket_obs(obs)
+        i_r, padded_r = reuse.bucket_obs(obs)
+        assert i_p == i_r
+        for k in padded_p:
+            np.testing.assert_array_equal(
+                np.asarray(padded_r[k]), np.asarray(padded_p[k]),
+                err_msg=k)
+        leased.append((i_r, padded_r))
+    for idx, padded in leased:
+        reuse.release(idx, padded)
+    # the next lease in a released bucket must come from the pool
+    idx0, padded0 = leased[-1]
+    pool_sizes = [len(p) for p in reuse._pools]
+    obs, n, m = _random_encoded_obs(rng, 8, 12)
+    i_new, _ = reuse.bucket_obs(obs)
+    assert len(reuse._pools[i_new]) == pool_sizes[i_new] - 1
+
+
+def test_serve_bucketer_pooled_arena_key_mismatch_gets_fresh_arena():
+    """Regression pin: an arena pooled from an obs with an EXTRA field
+    must not be handed to a later request lacking it (pad_obs_to(out=)
+    copies every out entry from the obs — a stale key would KeyError
+    mid-request); key-set mismatches lease a fresh arena instead."""
+    from ddls_tpu.serve.bucketing import ObsBucketer
+
+    reuse = ObsBucketer([(8, 12)], reuse_arenas=True)
+    rng = np.random.RandomState(11)
+    rich, _, _ = _random_encoded_obs(rng, 8, 12)
+    rich["client_tag"] = np.array([1.0], np.float32)  # extra field
+    idx, padded_rich = reuse.bucket_obs(rich)
+    reuse.release(idx, padded_rich)
+    plain, _, _ = _random_encoded_obs(rng, 8, 12)  # no client_tag
+    idx2, padded_plain = reuse.bucket_obs(plain)  # must not raise
+    assert "client_tag" not in padded_plain
+    # and the reverse direction: plain arena pooled, rich obs next
+    reuse.release(idx2, padded_plain)
+    rich2, _, _ = _random_encoded_obs(rng, 8, 12)
+    rich2["client_tag"] = np.array([2.0], np.float32)
+    _, padded_rich2 = reuse.bucket_obs(rich2)
+    np.testing.assert_array_equal(padded_rich2["client_tag"],
+                                  rich2["client_tag"])
+
+
+# ------------------------------------------------------------ tier-1 guard
+def test_check_shm_unlink_clean_tree():
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_shm_unlink.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_check_shm_unlink_flags_unpaired_create(tmp_path):
+    bad = tmp_path / "leaky.py"
+    bad.write_text(
+        "from multiprocessing import shared_memory\n"
+        "seg = shared_memory.SharedMemory(create=True, size=64)\n")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_shm_unlink.py"),
+         "--paths", str(tmp_path)],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    assert "leaky.py" in out.stdout
+
+    good = tmp_path / "leaky.py"
+    good.write_text(
+        "import weakref\n"
+        "from multiprocessing import shared_memory\n"
+        "seg = shared_memory.SharedMemory(create=True, size=64)\n"
+        "weakref.finalize(seg, seg.unlink)\n"
+        "# seg.unlink() on close\n")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "check_shm_unlink.py"),
+         "--paths", str(tmp_path)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout
