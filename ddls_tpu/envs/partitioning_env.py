@@ -26,6 +26,7 @@ from ddls_tpu.envs.obs import RampJobPartitioningObservation
 from ddls_tpu.envs.rewards import make_reward_function
 from ddls_tpu.sim.actions import Action, OpPartition
 from ddls_tpu.sim.cluster import RampClusterEnvironment
+from ddls_tpu.telemetry import flight as _flight
 
 OP_PLACERS = {
     "ramp_first_fit_op_placer": RampFirstFitOpPlacer,
@@ -202,6 +203,16 @@ class RampJobPartitioningEnvironment:
                     "apply_action_mask=False to silently fall back to 0")
             action = 0
 
+        # flight-recorder decision context, captured BEFORE the cluster
+        # step: the decided job (queue head), decision-time clock, mask
+        flight_ctx = None
+        if _flight.enabled():
+            head_job_id = next(iter(self.cluster.job_queue.jobs))
+            flight_ctx = (
+                self.cluster.job_id_to_job_idx[head_job_id],
+                self.cluster.stopwatch.time(),
+                [int(v) for v in np.asarray(self.obs["action_mask"])])
+
         if action != 0:
             job_id, job = next(iter(self.cluster.job_queue.jobs.items()))
             partition_map = {job_id: self._partition_action_for(job, action)}
@@ -244,6 +255,45 @@ class RampJobPartitioningEnvironment:
             self.cluster.jobs_running.get(self.last_job_arrived_job_idx)
             if self.last_job_arrived_job_idx in self.placed_job_idxs
             else None)
+
+        # one decision-level flight event: the exact tuple the jitted
+        # episode kernels trace per decision, so scripts/trace_diff.py
+        # can diff host decisions against make_episode_fn's replay
+        # trace. `accepted` is acceptance AT DECISION TIME (the kernels'
+        # semantics): a job placed by this action and then swept by
+        # episode finalisation inside the same cluster step
+        # (simulation_ended) counts as accepted here — the sweep is its
+        # own job_blocked event in the same trace.
+        if flight_ctx is not None and _flight.enabled():
+            ji, t_dec, mask = flight_ctx
+            cluster = self.cluster
+            pj = (cluster.jobs_running.get(ji)
+                  or cluster.jobs_completed.get(ji))
+            if pj is not None:
+                accepted, cause = True, None
+                jct = float(pj.details["lookahead_job_completion_time"])
+            else:
+                # blocked-cause ledger rides in jobs_blocked insertion
+                # order (register_blocked_job dedups, so positions align)
+                cause = cluster.episode_stats[
+                    "jobs_blocked_cause_of_unsuccessful_handling"][
+                    list(cluster.jobs_blocked).index(ji)]
+                accepted, jct = False, 0.0
+                if (cause == "simulation_ended"
+                        and ji in self.action.job_idxs):
+                    # placed, then swept at simulation end: accepted at
+                    # decision time; its jct is on the lookahead event
+                    # emitted earlier this step (the partitioned job
+                    # itself was already unmounted)
+                    accepted, cause = True, None
+                    for ev in reversed(_flight.recorder().events):
+                        if (ev["kind"] == "lookahead"
+                                and ev["job_idx"] == ji):
+                            jct = float(ev["jct"])
+                            break
+            _flight.emit("action_decided", t=t_dec, job_idx=ji,
+                         degree=action, mask=mask, accepted=accepted,
+                         cause=cause, jct=jct)
 
         # auto-step until another job queues or the episode ends, THEN
         # extract the reward so throughput rewards see the cluster steps in

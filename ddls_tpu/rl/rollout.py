@@ -22,6 +22,7 @@ import jax
 import numpy as np
 
 from ddls_tpu import telemetry
+from ddls_tpu.telemetry import flight
 
 OBS_KEYS = ("node_features", "edge_features", "graph_features",
             "edges_src", "edges_dst", "node_split", "edge_split",
@@ -157,7 +158,8 @@ class VectorEnv:
 
 def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
                          env_index: int, seed: int, seed_stride: int,
-                         telemetry_enabled: bool = False) -> None:
+                         telemetry_enabled: bool = False,
+                         flight_state: Optional[tuple] = None) -> None:
     """Subprocess body: owns one env, steps it on command, auto-resets.
 
     ``env_builder`` is a picklable callable (class or factory) receiving
@@ -170,6 +172,10 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
     the worker's counters — the sim-layer cache hit/miss counts live
     HERE, not in the parent — ride back on the "closed" ack and are
     merged into the parent registry by ``ParallelVectorEnv.close``.
+    ``flight_state`` (enabled, detail) mirrors the flight recorder the
+    same way: the simulator's event trace is emitted in THIS process,
+    drained on the close ack, and merged into the parent recorder tagged
+    with this worker's env index.
 
     Shared-memory protocol (the ``shm`` backend): on ``shm_open`` the
     worker maps the parent's slabs (rl/shm.py); step commands then carry
@@ -184,6 +190,8 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
     try:
         if telemetry_enabled:
             telemetry.enable()
+        if flight_state is not None and flight_state[0]:
+            flight.enable(detail=bool(flight_state[1]))
         env = env_builder(**env_kwargs)
         episode_return, episode_length = 0.0, 0
         while True:
@@ -236,10 +244,14 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
                     conn.send(("step",
                                (obs, float(reward), bool(done), record)))
             elif cmd == "close":
-                # counters only: cross-process histogram merge is lossy,
-                # and the sim layer records nothing but counters
+                # telemetry: counters only (cross-process histogram merge
+                # is lossy, and the sim layer records nothing but
+                # counters); flight: the full event trace, merged
+                # parent-side with this worker's env-index tag
                 counters = telemetry.snapshot().get("counters") or None
-                conn.send(("closed", counters))
+                trace = flight.drain() if flight.enabled() else None
+                conn.send(("closed", {"counters": counters,
+                                      "flight": trace}))
                 return
     except KeyboardInterrupt:
         pass
@@ -353,7 +365,8 @@ class ParallelVectorEnv:
             proc = ctx.Process(
                 target=_parallel_env_worker,
                 args=(child, env_builder, env_kwargs, i, self.seeds[i],
-                      num_envs, telemetry.enabled()),
+                      num_envs, telemetry.enabled(),
+                      (flight.enabled(), flight.detail_enabled())),
                 daemon=True)
             proc.start()
             child.close()
@@ -749,9 +762,12 @@ class ParallelVectorEnv:
         # worker's telemetry counters into this process's registry. One
         # SHARED 2 s deadline across all conns: a wedged worker must not
         # serially cost 2 s per env on the failure-path teardown (the
-        # join/terminate below still reaps it)
-        deadline = time.monotonic() + 2.0
-        for conn in self._conns:
+        # join/terminate below still reaps it). With the flight recorder
+        # on, the ack carries each worker's full event trace — give the
+        # drain real room so a long run's traces are not silently cut
+        # off mid-merge by the teardown budget
+        deadline = time.monotonic() + (30.0 if flight.enabled() else 2.0)
+        for i, conn in enumerate(self._conns):
             try:
                 while True:
                     remaining = deadline - time.monotonic()
@@ -759,9 +775,14 @@ class ParallelVectorEnv:
                         break
                     kind, payload = conn.recv()
                     if kind == "closed":
-                        if payload and telemetry.enabled():
-                            for name, value in payload.items():
+                        payload = payload or {}
+                        counters = payload.get("counters")
+                        if counters and telemetry.enabled():
+                            for name, value in counters.items():
                                 telemetry.inc(name, int(value))
+                        trace = payload.get("flight")
+                        if trace and flight.enabled():
+                            flight.extend(trace, env_index=i)
                         break
             except (EOFError, BrokenPipeError, OSError):
                 pass

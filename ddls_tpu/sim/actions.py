@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 from ddls_tpu import telemetry as _telemetry
 from ddls_tpu.demands.job import Job
+from ddls_tpu.telemetry import flight as _flight
 from ddls_tpu.graphs.readers import backward_op_id
 from ddls_tpu.sim.comm_model import one_to_one_time, ramp_all_reduce_time
 from ddls_tpu.sim.partition import partition_graph, partitioned_op_id
@@ -97,6 +98,11 @@ class OpPartition:
             if cached["immutable"] is None:
                 cached["immutable"] = partitioned.immutable
             self.partitioned_jobs[job_id] = partitioned
+            if _flight.enabled():
+                _flight.emit("partitioned", t=cluster.stopwatch.time(),
+                             job_idx=details["job_idx"], job_id=job_id,
+                             max_degree=max_degree,
+                             n_ops=pgraph.n_ops, n_deps=pgraph.n_deps)
 
     def __len__(self) -> int:
         return len(self.action)

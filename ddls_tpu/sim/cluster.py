@@ -45,6 +45,7 @@ import numpy as np
 
 from ddls_tpu import telemetry as _telemetry
 from ddls_tpu.demands.job import Job
+from ddls_tpu.telemetry import flight as _flight
 from ddls_tpu.demands.job_queue import JobQueue
 from ddls_tpu.demands.jobs_generator import JobsGenerator
 from ddls_tpu.hardware.topologies import build_topology
@@ -251,6 +252,12 @@ class RampClusterEnvironment:
             job.seq_completion_time)
         self.last_job_arrived_job_idx = job_idx
         self.episode_stats["num_jobs_arrived"] += 1
+        if _flight.enabled():
+            _flight.emit("job_arrived", t=self.stopwatch.time(),
+                         job_idx=job_idx, job_id=job.job_id,
+                         model=job.details.get("model"),
+                         num_training_steps=int(job.num_training_steps),
+                         sla_frac=float(job.max_acceptable_jct_frac))
         return job
 
     # ---------------------------------------------------------------- lookahead
@@ -316,6 +323,15 @@ class RampClusterEnvironment:
                     (ch.channel_id,
                      [(state.edge_index[dep], pri_map.get(dep, 0))
                       for dep in sorted(ch.mounted_job_idx_to_deps[job_idx])]))
+
+        # flight detail: per-op/flow completion events from THIS engine's
+        # ticking (the C++/jax engines return aggregates only, which is
+        # why cross-backend diffs exclude these kinds by default); one
+        # gate read before the loop, zero cost when off
+        detail_enabled = _flight.detail_enabled()
+        if detail_enabled:
+            op_ids = graph.finalize()["op_ids"]
+            t_now = self.stopwatch.time()
 
         t = comm_oh = comp_oh = busy = 0.0
         guard = 0
@@ -394,9 +410,13 @@ class RampClusterEnvironment:
             ticked_ops = False
             active_workers = 0
             for oi in selected_ops:
-                state.tick_op(oi, tick)
+                finished = state.tick_op(oi, tick)
                 ticked_ops = True
                 active_workers += 1
+                if detail_enabled and finished:
+                    _flight.emit("op_completed", t=t_now,
+                                 job_idx=job_idx, op=op_ids[oi],
+                                 lt=t + tick)
 
             ticked_flows = False
             if non_flow:
@@ -404,8 +424,13 @@ class RampClusterEnvironment:
                     state.tick_dep(ei, tick)
             else:
                 for ei in deps_snapshot:
-                    state.tick_dep(ei, tick)
+                    finished = state.tick_dep(ei, tick)
                     ticked_flows = True
+                    if detail_enabled and finished:
+                        _flight.emit("flow_completed", t=t_now,
+                                     job_idx=job_idx,
+                                     dep=list(state.edge_ids[ei]),
+                                     lt=t + tick)
 
             if ticked_ops and ticked_flows:
                 comm_oh += tick
@@ -489,6 +514,9 @@ class RampClusterEnvironment:
             job = self.jobs_running[job_idx]
             key = self._lookahead_cache_key(job, job_id)
             cached = self.lookahead_cache.get(key)
+            # which engine serves THIS decision's lookahead ("cache" on a
+            # memo hit): telemetry counters + the flight lookahead event
+            backend = "cache"
             if cached is None:
                 # explicit jax opt-in outranks the auto-enabled native
                 # engine; host engine is the always-correct fallback
@@ -516,6 +544,11 @@ class RampClusterEnvironment:
             # event-driven off the lookahead JCT, not this counter)
             job.training_step_counter += 1
             jct, comm_oh, comp_oh, busy = cached
+            if _flight.enabled():
+                _flight.emit("lookahead", t=self.stopwatch.time(),
+                             job_idx=job_idx, job_id=job_id,
+                             backend=backend, jct=jct, comm_oh=comm_oh,
+                             comp_oh=comp_oh, busy=busy)
             self._register_completed_lookahead(job, jct, comm_oh, comp_oh,
                                                busy)
 
@@ -646,6 +679,9 @@ class RampClusterEnvironment:
                 tick = min(tick, remaining)
             tick = max(tick, 0.0)
 
+            if _flight.enabled():
+                _flight.emit("tick", t=self.stopwatch.time(), dt=tick,
+                             n_running=len(self.jobs_running))
             self._accumulate_tick_stats(tick)
             self.stopwatch.tick(tick)
 
@@ -719,6 +755,11 @@ class RampClusterEnvironment:
             sc = op_placement.job_server_codes.get(job_id)
             if sc is not None:
                 self.job_server_codes[job_idx] = sc
+            if _flight.enabled():
+                _flight.emit("placed", t=self.stopwatch.time(),
+                             job_idx=job_idx, job_id=job_id,
+                             workers=sorted(by_worker),
+                             n_ops=len(op_to_worker))
             self._register_running_job(job)
             self.job_op_placement[job_id] = dict(op_to_worker)
 
@@ -780,6 +821,12 @@ class RampClusterEnvironment:
                 job.details["mounted_channels"].update(
                     payload.channels.tolist())
                 self.job_dep_placement[job_id] = payload
+                if _flight.enabled():
+                    _flight.emit(
+                        "mounted", t=self.stopwatch.time(),
+                        job_idx=job_idx, job_id=job_id,
+                        channels=sorted(payload.channels.tolist()),
+                        occ_used=int((self.channel_occ != -1).sum()))
             return
         channel_lookup = self.topology.channel_id_to_channel
         # keep channel_occ the single occupancy truth on dense topologies
@@ -825,6 +872,11 @@ class RampClusterEnvironment:
                 if ci is not None:
                     self.channel_occ[ci] = job_idx
             self.job_dep_placement[job_id] = dep_to_channels
+            if _flight.enabled():
+                _flight.emit("mounted", t=self.stopwatch.time(),
+                             job_idx=job_idx, job_id=job_id,
+                             channels=sorted(ch_to_deps),
+                             occ_used=int((self.channel_occ != -1).sum()))
 
     def _schedule_deps(self, dep_schedule) -> None:
         for ch_id, job_to_deps in dep_schedule.action.items():
@@ -876,6 +928,9 @@ class RampClusterEnvironment:
         self.episode_stats["num_jobs_completed"] += 1
 
         jct = job.details["time_completed"] - job.details["time_arrived"]
+        if _flight.enabled():
+            _flight.emit("job_completed", t=self.stopwatch.time(),
+                         job_idx=job_idx, job_id=job.job_id, jct=jct)
         e = self.episode_stats
         e["job_completion_time"].append(jct)
         e["job_completion_time_speedup"].append(
@@ -922,6 +977,9 @@ class RampClusterEnvironment:
         self.jobs_running.pop(job_idx, None)
         if job_idx in self.jobs_blocked:
             return
+        if _flight.enabled():
+            _flight.emit("job_blocked", t=self.stopwatch.time(),
+                         job_idx=job_idx, job_id=job.job_id, cause=cause)
         self.jobs_blocked[job_idx] = job
         self.step_stats["num_jobs_blocked"] += 1
         self.episode_stats["num_jobs_blocked"] += 1

@@ -18,16 +18,13 @@ trace time). First-fit order is recovered by ranking anchors
 lexicographically and taking the minimum rank over valid anchors of the
 first shape that has any. Regular (non-diagonal) blocks anchored inside
 the meta shape never actually wrap (span = meta - shape + 1 bounds the
-origin), matching ``enumerate_block``'s modulo arithmetic exactly; the
-reference's diagonal S == -1 layout stays host-side
-(`block_search.enumerate_block:61`).
-
-Scope note (honest go/no-go): this jits the *search primitive*. The full
-placer remains a per-op loop with parent-colocation preferences and
-occupancy updates between ops (`placers.allocate_job`); folding that loop
-into a `lax.scan` over ops is the remaining work, not a semantics
-question — each step is exactly this primitive plus a scatter into the
-free mask.
+origin), matching ``enumerate_block``'s modulo arithmetic exactly. The
+reference's diagonal S == -1 layout is handled by the full jitted placer
+(`sim/jax_env.py` ShapeTables carries the diagonal shapes with their
+wrap bases), which folded the per-op loop with parent-colocation
+preferences and occupancy updates into a `lax.scan`
+(`jax_env.jax_allocate_job`, parity-fuzzed in tests/test_jax_placer.py);
+this module remains the search *primitive* that scan consumes.
 """
 from __future__ import annotations
 

@@ -23,20 +23,26 @@ lookahead -> SLA gate -> masked commit. The episode loop advances the event
 clock (completions, arrivals) between decisions exactly like
 ``RampClusterEnvironment.step``'s tick loop.
 
-Build state: the table builders and the scan-ified `jax_allocate_job`
-kernel (parity-fuzzed in tests/test_jax_placer.py) are landed; the pricing
-/ score / decision-step / episode kernels consume the dep, grouping, and
-rank tables stacked here and land on top.
+Build state: ALL stages are landed and parity-pinned — the table
+builders and the scan-ified `jax_allocate_job` kernel (parity-fuzzed in
+tests/test_jax_placer.py), the pricing/score kernels
+(tests/test_jax_pricing.py), the replay/policy/oracle episode kernels
+(x64 full-episode drivers tests/test_jax_episode.py,
+test_jax_policy_episode.py, test_jax_oracle_episode.py) and the
+fixed-length segment kernel feeding the device PPO collector
+(tests/test_ppo_device.py). The in-kernel observation (`_kernel_obs`)
+is BIT-equal to `envs/obs.py` (same formulas, same f64-then-f32 cast
+order — CLAUDE.md invariant).
 
 Numerics: tables are built in f64; under ``JAX_ENABLE_X64=1`` the whole
-step runs in f64 and is expected to reproduce host decisions exactly
-(the parity test runs that way); under default f32 results carry f32
-rounding — same trade as ``use_jax_lookahead``.
+step runs in f64 and reproduces host decisions exactly (the parity
+drivers run that way); under default f32 results carry f32 rounding —
+same trade as ``use_jax_lookahead``.
 
 Scope (honest): the placement-shaping env's restricted meta blocks and
-multi-channel topologies stay host-side; observation/GNN feature extraction
-is not in-kernel (the parity artifact replays recorded actions, the bench
-uses a constant-degree policy with the in-kernel action mask).
+multi-channel topologies stay host-side, and price-feature observations
+are episode-kernel-only (the compact segment trace carries no pricing
+state — `make_segment_fn` rejects them loudly).
 """
 from __future__ import annotations
 
@@ -764,6 +770,23 @@ CAUSE_DEP_PLACEMENT = 3
 CAUSE_SLA = 4                # max_acceptable_job_completion_time_exceeded
 CAUSE_ENGINE = 5             # lookahead non-convergence / non-finite price
                              # (the host raises; must never appear)
+
+# trace-code <-> host cause-string maps (flight-recorder decision diffs:
+# scripts/trace_diff.py converts a jitted decision trace into the same
+# `action_decided` events the host env emits). CAUSE_ACCEPTED maps to
+# None — accepted decisions carry no blocked cause.
+CAUSE_CODE_TO_STR = {
+    CAUSE_ACCEPTED: None,
+    CAUSE_NOT_HANDLED: "not_handled",
+    CAUSE_OP_PLACEMENT: "op_placement",
+    CAUSE_DEP_PLACEMENT: "dep_placement",
+    CAUSE_SLA: "max_acceptable_job_completion_time_exceeded",
+    CAUSE_ENGINE: "engine_failure",
+}
+CAUSE_STR_TO_CODE = {v: k for k, v in CAUSE_CODE_TO_STR.items()
+                     if v is not None}
+# the host's per-sub-action causes that collapse onto one code
+CAUSE_STR_TO_CODE["op_partition"] = CAUSE_OP_PLACEMENT
 
 
 @dataclasses.dataclass

@@ -14,7 +14,11 @@ Reads the three record types ``ddls_tpu.telemetry`` writes
 * the LAST ``snapshot`` record supplies the counters / gauges /
   histograms tables (histogram percentiles fall back to fixed-bucket
   interpolation via ``percentile_from_bucket_counts`` when the snapshot
-  carries buckets but no window percentiles).
+  carries buckets but no window percentiles);
+* ``flight`` records (episode flight-recorder traces,
+  ``ddls_tpu.telemetry.flight`` — also the whole-file format
+  ``flight.save_jsonl`` writes) get a trace summary: events by kind,
+  blocks by cause, and a per-job lifecycle table.
 
 Exit codes: 0 on success (even for an empty file — it says so), 2 when
 the file is missing/unreadable.
@@ -122,11 +126,57 @@ def _overlap_section(intervals: List[tuple]) -> List[str]:
     return lines + [""]
 
 
+def _flight_section(flight_events: List[dict]) -> List[str]:
+    """Trace summary: events by kind, blocks by cause, per-job
+    lifecycle (arrival -> decision -> placement -> outcome)."""
+    from ddls_tpu.telemetry import flight
+
+    summ = flight.summarize(flight_events)
+    lines = [f"== flight trace ({summ['n_events']} events, sim horizon "
+             f"t={summ['t_end']:.6g}) ==",
+             f"{'kind':<24}{'count':>8}"]
+    for kind, n in sorted(summ["by_kind"].items()):
+        lines.append(f"{kind:<24}{n:>8}")
+    if summ["blocked_by_cause"]:
+        lines += ["", f"{'blocked by cause':<44}{'count':>8}"]
+        for cause, n in sorted(summ["blocked_by_cause"].items()):
+            lines.append(f"{cause:<44}{n:>8}")
+    jobs = summ["jobs"]
+    if jobs:
+        lines += ["", f"{'job':>9} {'arrived':>12} {'deg':>4} "
+                      f"{'placed':>12} {'jct':>12} {'outcome':<42}"]
+        max_rows = 50
+
+        def cell(v, fmt="{:.6g}"):
+            return "-" if v is None else fmt.format(v)
+
+        # insertion order == first-appearance (arrival) order; labels are
+        # env/generation-qualified strings (flight._iter_labeled)
+        for ji in list(jobs)[:max_rows]:
+            r = jobs[ji]
+            if "completed" in r:
+                outcome = f"completed @ {r['completed']:.6g}"
+            elif "blocked" in r:
+                outcome = (f"blocked @ {r['blocked']:.6g} "
+                           f"({r.get('cause', '?')})")
+            else:
+                outcome = "running at trace end"
+            lines.append(
+                f"{ji:>9} {cell(r.get('arrived')):>12} "
+                f"{cell(r.get('degree'), '{}'): >4} "
+                f"{cell(r.get('placed')):>12} "
+                f"{cell(r.get('jct')):>12} {outcome:<42}")
+        if len(jobs) > max_rows:
+            lines.append(f"... ({len(jobs) - max_rows} more jobs)")
+    return lines + [""]
+
+
 def render_report(path: str) -> List[str]:
     span_durations: Dict[str, List[float]] = defaultdict(list)
     span_intervals: List[tuple] = []
     event_counts: Dict[tuple, int] = defaultdict(int)
     event_last: Dict[tuple, dict] = {}
+    flight_events: List[dict] = []
     last_snapshot: Dict[str, Any] = {}
     n_lines = n_bad = 0
     with open(path) as f:
@@ -154,6 +204,8 @@ def render_report(path: str) -> List[str]:
                 event_last[key] = rec
             elif kind == "snapshot":
                 last_snapshot = rec.get("data") or {}
+            elif kind == "flight":
+                flight_events.append(rec)
 
     lines = [f"telemetry report: {path} ({n_lines} records"
              + (f", {n_bad} unparseable" if n_bad else "") + ")", ""]
@@ -163,6 +215,8 @@ def render_report(path: str) -> List[str]:
         lines += [""]
     if span_intervals:
         lines += _overlap_section(span_intervals)
+    if flight_events:
+        lines += _flight_section(flight_events)
     if event_counts:
         lines += ["== events ==",
                   f"{'kind':<24}{'phase':<18}{'count':>7}  last"]

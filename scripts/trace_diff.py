@@ -1,0 +1,317 @@
+"""Cross-backend simulator trace diffing: find the FIRST divergent event.
+
+The build carries four semantics-locked simulator backends (host Python,
+C++ lookahead, jax lookahead, fully-jitted episode kernels) whose parity
+tests pin endpoints only — this tool turns "parity failed" into "event
+412: lookahead jct 3.81 vs 3.84" by running ONE scenario through two
+backends with the flight recorder on (ddls_tpu/telemetry/flight.py) and
+reporting the first event where the ordered traces disagree, with both
+sides' full payload context.
+
+Usage::
+
+    # seeded episode, host vs C++ lookahead engine (bit-exact expected)
+    python scripts/trace_diff.py run --backend-a host --backend-b native
+
+    # host decisions vs the fully-jitted episode replay (x64, 1e-9 rtol)
+    python scripts/trace_diff.py run --backend-b jitted
+
+    # diff two previously saved traces (e.g. from --save-a/--save-b)
+    python scripts/trace_diff.py files a.jsonl b.jsonl
+
+Backends: ``host`` (pure-Python lookahead), ``native`` (C++ engine),
+``jax`` (jitted lookahead kernel — f32 by default, so expect rounding
+divergence unless JAX_ENABLE_X64=1), ``jitted`` (the whole-episode
+kernel ``sim/jax_env.py:make_episode_fn`` replaying the host action
+sequence; compared at decision level — `action_decided` events only,
+mask context dropped since the replay kernel sees no observation).
+
+The comparison excludes detail kinds (per-op/flow completions exist only
+on the host engine) and context fields (``backend``, ``seq``, ``env``)
+by default — see flight.comparable_events.
+
+Exit codes: 0 traces identical, 1 divergence found, 2 usage/error,
+3 requested backend unavailable.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# sim-only workload: never let a wedged axon tunnel hang a trace diff
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+HOST_BACKENDS = ("host", "native", "jax")
+
+
+def make_env(dataset_dir: str, backend: str, max_sim_run_time: float):
+    """The canonical single-channel RAMP scenario (8 servers — the same
+    shape the golden tests pin) with the requested lookahead backend."""
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+
+    return RampJobPartitioningEnvironment(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 1000.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.1, "max_val": 1.0, "decimals": 2},
+            "replication_factor": 10,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 50},
+        max_partitions_per_op=8,
+        min_op_run_time_quantum=0.01,
+        reward_function="job_acceptance",
+        reward_function_kwargs={"fail_reward": -1, "success_reward": 1},
+        max_simulation_run_time=max_sim_run_time,
+        pad_obs_kwargs={"max_nodes": 64, "max_edges": 256},
+        use_jax_lookahead=(backend == "jax"),
+        use_native_lookahead=(backend == "native"))
+
+
+def run_recorded_episode(env, seed: int, actions=None,
+                         max_decisions: int = 500, detail: bool = False):
+    """One seeded episode under a fresh flight recorder; returns
+    (events, actions_taken). With ``actions`` given, replays that
+    sequence (truncating when the episode ends early or a replayed
+    action goes mask-invalid — both only happen past a divergence, which
+    the diff will already have found)."""
+    import numpy as np
+
+    from ddls_tpu.telemetry import flight
+
+    prev = (flight.recorder().enabled, flight.recorder().detail)
+    flight.reset()
+    flight.enable(detail=detail)
+    try:
+        obs = env.reset(seed=seed)
+        rng = np.random.RandomState(seed)
+        taken = []
+        done = False
+        while not done and len(taken) < max_decisions:
+            if actions is not None:
+                if len(taken) >= len(actions):
+                    break
+                action = int(actions[len(taken)])
+            else:
+                valid = np.flatnonzero(np.asarray(obs["action_mask"]))
+                action = int(rng.choice(valid))
+            try:
+                obs, _, done, _ = env.step(action)
+            except ValueError:
+                break  # replayed action invalid here: post-divergence
+            taken.append(action)
+        events = flight.drain()
+    finally:
+        flight.reset()
+        flight.recorder().enabled, flight.recorder().detail = prev
+    return events, taken
+
+
+def decision_events(events):
+    """The decision-level view of a host trace: `action_decided` events
+    with the observation-mask context dropped (the jitted replay kernel
+    sees no observation, so the mask is host-only context here) and the
+    blocked cause CANONICALISED through the trace-code maps — several
+    host sub-action causes collapse onto one code (e.g. 'op_partition'
+    -> op_placement), and the jitted side can only ever name the
+    canonical string."""
+    from ddls_tpu.sim.jax_env import CAUSE_CODE_TO_STR, CAUSE_STR_TO_CODE
+    from ddls_tpu.telemetry import flight
+
+    out = []
+    for e in flight.comparable_events(events, kinds=("action_decided",)):
+        e = {k: v for k, v in e.items() if k != "mask"}
+        code = CAUSE_STR_TO_CODE.get(e.get("cause"))
+        if code is not None:
+            e["cause"] = CAUSE_CODE_TO_STR[code]
+        out.append(e)
+    return out
+
+
+def jitted_decision_events(env, host_events, actions):
+    """Replay the host action sequence through the fully-jitted episode
+    kernel and express its per-decision trace as `action_decided`
+    events (the job bank is rebuilt from the host trace's own
+    job_arrived events)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddls_tpu.sim.jax_env import (CAUSE_CODE_TO_STR,
+                                      build_episode_tables,
+                                      build_job_bank, make_episode_fn)
+
+    arrivals = [{"model": e["model"],
+                 "num_training_steps": e["num_training_steps"],
+                 "sla_frac": e["sla_frac"],
+                 "time_arrived": e["t"]}
+                for e in host_events if e["kind"] == "job_arrived"]
+    et = build_episode_tables(env)
+    bank = build_job_bank(et, arrivals)
+    out = make_episode_fn(et)(
+        {k: jnp.asarray(v) for k, v in bank.items()},
+        jnp.asarray(actions, jnp.int32))
+    reward, accept, cause, jct, t, has_job = (np.asarray(x)
+                                              for x in out["trace"])
+    events = []
+    for i, action in enumerate(actions):
+        if not has_job[i]:
+            break  # kernel ran out of queued jobs (post-divergence)
+        accepted = bool(accept[i])
+        events.append({
+            "kind": "action_decided", "t": float(t[i]), "job_idx": i,
+            "degree": int(action), "accepted": accepted,
+            "cause": CAUSE_CODE_TO_STR[int(cause[i])],
+            "jct": float(jct[i]) if accepted else 0.0})
+    return events
+
+
+def _report(div, label_a: str, label_b: str, n_a: int, n_b: int) -> int:
+    from ddls_tpu.telemetry import flight
+
+    print(f"compared {n_a} ({label_a}) vs {n_b} ({label_b}) events")
+    print(flight.format_divergence(div, label_a=label_a, label_b=label_b))
+    return 0 if div is None else 1
+
+
+def cmd_run(args) -> int:
+    from ddls_tpu.telemetry import flight
+
+    for b in (args.backend_a, args.backend_b):
+        if b == "native":
+            from ddls_tpu.native import native_available
+
+            if not native_available():
+                print("error: C++ lookahead engine unavailable "
+                      "(ddls_tpu/native did not build/load)",
+                      file=sys.stderr)
+                return 3
+    if args.backend_b == "jitted" and args.backend_a != "host":
+        print("error: jitted decision diffs compare against the host "
+              "backend (--backend-a host)", file=sys.stderr)
+        return 2
+
+    dataset = args.dataset
+    if dataset is None:
+        from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+
+        dataset = tempfile.mkdtemp(prefix="trace_diff_jobs_")
+        generate_pipedream_txt_files(dataset, n_cnn=2, n_translation=1,
+                                     seed=0, min_ops=4, max_ops=6)
+
+    env_a = make_env(dataset, args.backend_a, args.sim_seconds)
+    events_a, actions = run_recorded_episode(
+        env_a, args.seed, max_decisions=args.max_decisions,
+        detail=args.detail)
+    print(f"backend A ({args.backend_a}): {len(events_a)} events over "
+          f"{len(actions)} decisions")
+    if args.save_a:
+        flight.save_jsonl(args.save_a, events_a)
+
+    if args.backend_b == "jitted":
+        a = decision_events(events_a)
+        b = jitted_decision_events(env_a, events_a, actions)
+        rtol = args.rtol if args.rtol is not None else 1e-9
+    else:
+        env_b = make_env(dataset, args.backend_b, args.sim_seconds)
+        events_b, _ = run_recorded_episode(
+            env_b, args.seed, actions=actions, detail=args.detail)
+        print(f"backend B ({args.backend_b}): {len(events_b)} events")
+        if args.save_b:
+            flight.save_jsonl(args.save_b, events_b)
+        a = flight.comparable_events(events_a,
+                                     include_detail=args.include_detail)
+        b = flight.comparable_events(events_b,
+                                     include_detail=args.include_detail)
+        rtol = args.rtol if args.rtol is not None else 0.0
+
+    div = flight.first_divergence(a, b, rtol=rtol)
+    return _report(div, args.backend_a, args.backend_b, len(a), len(b))
+
+
+def cmd_files(args) -> int:
+    from ddls_tpu.telemetry import flight
+
+    for path in (args.trace_a, args.trace_b):
+        if not os.path.exists(path):
+            print(f"error: no such file: {path}", file=sys.stderr)
+            return 2
+    kinds = args.kinds or None
+    a = flight.comparable_events(flight.load_jsonl(args.trace_a),
+                                 kinds=kinds,
+                                 include_detail=args.include_detail)
+    b = flight.comparable_events(flight.load_jsonl(args.trace_b),
+                                 kinds=kinds,
+                                 include_detail=args.include_detail)
+    div = flight.first_divergence(a, b, rtol=args.rtol or 0.0)
+    return _report(div, os.path.basename(args.trace_a),
+                   os.path.basename(args.trace_b), len(a), len(b))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="diff simulator flight traces across backends")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    run = sub.add_parser("run", help="run one scenario through two "
+                                     "backends and diff the traces")
+    run.add_argument("--backend-a", default="host", choices=HOST_BACKENDS)
+    run.add_argument("--backend-b", default="native",
+                     choices=HOST_BACKENDS + ("jitted",))
+    run.add_argument("--seed", type=int, default=0)
+    run.add_argument("--dataset", default=None,
+                     help="graph-file dir (default: synthesize a small "
+                          "deterministic set)")
+    run.add_argument("--sim-seconds", type=float, default=2e4,
+                     help="simulated episode horizon")
+    run.add_argument("--max-decisions", type=int, default=500)
+    run.add_argument("--detail", action="store_true",
+                     help="record per-op/flow lookahead detail events")
+    run.add_argument("--include-detail", action="store_true",
+                     help="ALSO diff detail kinds (host-engine only — "
+                          "diverges by construction across backends)")
+    run.add_argument("--rtol", type=float, default=None,
+                     help="float tolerance (default 0 = bit-exact; "
+                          "jitted mode defaults to 1e-9)")
+    run.add_argument("--save-a", default=None, help="save trace A JSONL")
+    run.add_argument("--save-b", default=None, help="save trace B JSONL")
+    run.set_defaults(fn=cmd_run)
+
+    files = sub.add_parser("files", help="diff two saved trace files")
+    files.add_argument("trace_a")
+    files.add_argument("trace_b")
+    files.add_argument("--include-detail", action="store_true")
+    files.add_argument("--rtol", type=float, default=0.0)
+    files.add_argument("--kinds", nargs="*", default=None,
+                       help="restrict the diff to these event kinds")
+    files.set_defaults(fn=cmd_files)
+
+    args = parser.parse_args(argv)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
