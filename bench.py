@@ -25,6 +25,7 @@ tunnelled-TPU environment constants in BASELINE.md.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import subprocess
@@ -788,6 +789,14 @@ def run_serve_bench(args) -> dict:
         params_source = "random_init"
 
     buckets = default_buckets(bounds["max_nodes"], bounds["max_edges"])
+
+    if args.replicas > 1 or args.load == "trace":
+        # the fleet path (ISSUE 8): trace-driven open-loop load through
+        # the Router; also serves multi-replica Poisson (the trace
+        # degenerates to plain Poisson with modulation knobs zeroed)
+        return _run_serve_fleet_bench(args, model, params, graph_dim,
+                                      pool, buckets, params_source)
+
     server = PolicyServer(model, params, buckets=buckets,
                           max_batch=args.serve_max_batch,
                           deadline_s=args.serve_deadline_ms / 1e3,
@@ -796,18 +805,10 @@ def run_serve_bench(args) -> dict:
 
     # compile every bucket before timing (each bucket compiles exactly
     # once; the compile belongs to startup, not to steady-state latency)
-    for spec_idx in range(len(server.bucketer.buckets)):
-        for o in pool:
-            n = int(np.asarray(o["node_split"]).reshape(-1)[0])
-            m = int(np.asarray(o["edge_split"]).reshape(-1)[0])
-            if server.bucketer.bucket_index(n, m) == spec_idx:
-                server.submit(o)
-                server.drain()
-                break
-    server.stats = type(server.stats)()  # reset counters post-warmup
+    _warm_server(server, pool)
 
     telemetry.enable()
-    rng = np.random.RandomState(1)
+    rng = np.random.RandomState(args.load_seed)
     n = args.serve_requests
     arrivals = np.cumsum(rng.exponential(1.0 / args.serve_rps, size=n))
     responses = []
@@ -864,6 +865,12 @@ def run_serve_bench(args) -> dict:
         "deadline_ms": args.serve_deadline_ms,
         "buckets": [list(b) for b in buckets],
         "params_source": params_source,
+        # reproducibility triplet (ISSUE 8 satellite): every serve line
+        # names its load seed, content fingerprint, and replica count
+        "replicas": 1,
+        "load": {"mode": "poisson", "seed": args.load_seed,
+                 "fingerprint": hashlib.sha256(
+                     np.round(arrivals, 9).tobytes()).hexdigest()[:16]},
         "cores": _available_cores(),
         # global spans/probe counters + the server's private registry
         # (serve.latency_s histogram etc. — same window the p50/p99
@@ -871,6 +878,205 @@ def run_serve_bench(args) -> dict:
         "telemetry": {**telemetry.snapshot(),
                       "serve": server.stats.registry.snapshot()},
     }
+
+
+def _warm_server(server, pool) -> None:
+    """Per-bucket compile warmup (one obs per bucket rung, then a stats
+    reset): compile belongs to startup, never to measured serving.
+    Shared by the single-server path and the fleet's ``warm_replica``
+    hook so the warmup discipline cannot drift between them."""
+    for spec_idx in range(len(server.bucketer.buckets)):
+        for o in pool:
+            n = int(np.asarray(o["node_split"]).reshape(-1)[0])
+            m = int(np.asarray(o["edge_split"]).reshape(-1)[0])
+            if server.bucketer.bucket_index(n, m) == spec_idx:
+                server.submit(o)
+                server.drain()
+                break
+    server.stats = type(server.stats)()  # warmup never counts
+
+
+def _run_serve_fleet_bench(args, model, params, graph_dim, pool, buckets,
+                           params_source) -> dict:
+    """Multi-replica / trace-driven serving measurement (ISSUE 8): a
+    seeded, fingerprinted open-loop trace (diurnal cycle + bursts +
+    heavy-tailed sizes; plain Poisson when --load poisson) drives the
+    fleet Router in real time. Every request is submitted with its
+    SCHEDULED arrival as ``now`` — latency is charged from when the
+    request was supposed to arrive, not when the loop got to it, so the
+    reported p50/p99/p999 are coordinated-omission-correct exactly in
+    overload. The JSON line carries SLO attainment + goodput against
+    ``--slo-ms``, shed/degraded rates, per-replica occupancy, and the
+    (seed, fingerprint, resolved-replica-count) triplet that makes serve
+    numbers comparable across rounds."""
+    import jax
+
+    from ddls_tpu.serve import (AutoscaleConfig, AutoscaleController,
+                                Autoscaler, build_fleet)
+    from ddls_tpu.serve import loadgen
+
+    n = args.serve_requests
+    expected_duration = n / args.serve_rps
+    is_trace = args.load == "trace"
+    trace = loadgen.generate_trace(
+        n_requests=n, base_rps=args.serve_rps, seed=args.load_seed,
+        # periods default to fractions of the expected duration so a
+        # short bench still sweeps full diurnal/burst cycles
+        diurnal_period_s=(args.trace_diurnal_period_s
+                          or expected_duration / 2),
+        diurnal_amplitude=(args.trace_diurnal_amplitude if is_trace
+                           else 0.0),
+        burst_factor=args.trace_burst_factor if is_trace else 1.0,
+        burst_period_s=(args.trace_burst_period_s
+                        or expected_duration / 4),
+        burst_duty=args.trace_burst_duty,
+        size_tail_alpha=args.trace_size_alpha,
+        n_tenants=args.trace_tenants)
+    loadgen.validate_trace(trace)
+    fingerprint = loadgen.trace_fingerprint(trace)
+
+    def warm_replica(server):
+        # the Router runs this for the initial fleet AND every autoscale
+        # scale-up, so a mid-run replica addition never serves its first
+        # batches cold
+        _warm_server(server, pool)
+
+    router = build_fleet(
+        model, params, n_replicas=args.replicas,
+        routing=args.serve_routing, shed_enabled=True,
+        quota_rps=args.serve_quota_rps or None,
+        warm_replica=warm_replica,
+        buckets=buckets, max_batch=args.serve_max_batch,
+        deadline_s=args.serve_deadline_ms / 1e3,
+        max_queue=args.serve_max_queue, graph_feature_dim=graph_dim)
+
+    if is_trace:
+        # heavy-tailed size ranks map onto the obs pool sorted by true
+        # graph size: rank 0 -> smallest arriving graph, rank ~1 ->
+        # largest
+        by_size = sorted(
+            pool, key=lambda o: (int(np.asarray(o["node_split"])[0]),
+                                 int(np.asarray(o["edge_split"])[0])))
+        sized = [by_size[min(int(f * len(by_size)), len(by_size) - 1)]
+                 for f in trace["size_frac"]]
+    else:
+        # poisson mode cycles the pool uniformly, exactly like the
+        # single-server path — a --replicas 1 vs N comparison must
+        # serve the SAME job-size mix (the trace's size_frac is unused)
+        sized = [pool[i % len(pool)] for i in range(n)]
+    router.reset_stats()
+
+    controller = None
+    if args.serve_autoscale:
+        controller = AutoscaleController(router, Autoscaler(
+            AutoscaleConfig(min_replicas=1,
+                            max_replicas=args.serve_autoscale_max,
+                            target_p99_ms=args.slo_ms)))
+
+    telemetry.enable()
+    arrivals = np.asarray(trace["arrival_s"])
+    tenants = trace["tenant"]
+    responses = []
+    last_scale_t = 0.0
+    with telemetry.span("bench.run") as run_span:
+        start = time.perf_counter()
+        i = 0
+        while len(responses) < n:
+            now = time.perf_counter()
+            while i < n and now - start >= arrivals[i]:
+                # scheduled-arrival timestamp, never the loop instant
+                # (coordinated omission — see run_serve_bench); sheds
+                # resolve inside submit and surface on the next poll
+                router.submit(sized[i], now=start + arrivals[i],
+                              tenant=tenants[i] if is_trace else None)
+                i += 1
+                now = time.perf_counter()
+            responses.extend(router.poll())
+            if len(responses) >= n:
+                break
+            if (controller is not None
+                    and now - start - last_scale_t
+                    >= args.serve_autoscale_interval_s):
+                controller.step(now=now)
+                last_scale_t = now - start
+            next_events = [start + arrivals[i]] if i < n else []
+            deadline = router.next_deadline()
+            if deadline is not None:
+                next_events.append(deadline)
+            if next_events:
+                time.sleep(min(max(min(next_events) - time.perf_counter(),
+                                   0.0), 0.005))
+            elif i >= n:
+                responses.extend(router.drain())
+    elapsed = run_span.duration_s
+
+    slo = loadgen.slo_summary(responses, slo_s=args.slo_ms / 1e3,
+                              duration_s=elapsed)
+    per_replica = router.per_replica_summary()
+    snapshots = router.registry_snapshots()
+    payload = {
+        "metric": "serve_decisions_per_sec",
+        "value": round(slo["n_decided"] / elapsed, 2),
+        "unit": "decisions/s",
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "platform": jax.devices()[0].platform,
+        "p50_latency_ms": (round(slo["p50_latency_ms"], 3)
+                           if slo["p50_latency_ms"] is not None else None),
+        "p99_latency_ms": (round(slo["p99_latency_ms"], 3)
+                           if slo["p99_latency_ms"] is not None else None),
+        "p999_latency_ms": (round(slo["p999_latency_ms"], 3)
+                            if slo["p999_latency_ms"] is not None
+                            else None),
+        "slo_ms": args.slo_ms,
+        "slo_attainment": round(slo["slo_attainment"], 4),
+        "goodput_rps": round(slo["goodput_rps"], 2),
+        "shed_rate": round(slo["shed_rate"], 4),
+        "degraded_rate": round(slo["degraded_rate"], 4),
+        "offered_rps": args.serve_rps,
+        "num_requests": n,
+        "max_batch": args.serve_max_batch,
+        "deadline_ms": args.serve_deadline_ms,
+        "buckets": [list(b) for b in buckets],
+        "params_source": params_source,
+        "routing": args.serve_routing,
+        # the reproducibility triplet + per-replica occupancy the
+        # acceptance names
+        "replicas": len(router.replica_set.replicas),
+        "replicas_requested": args.replicas,
+        "per_replica": {
+            rid: {"n_requests": s["n_requests"],
+                  "batch_occupancy": (round(s["batch_occupancy"], 3)
+                                      if s["batch_occupancy"] is not None
+                                      else None),
+                  "p99_latency_ms": (round(s["p99_latency_ms"], 3)
+                                     if s["p99_latency_ms"] is not None
+                                     else None),
+                  "fallback_rate": round(s["fallback_rate"], 4)}
+            for rid, s in per_replica.items()},
+        "load": {"mode": args.load, "seed": args.load_seed,
+                 "fingerprint": fingerprint,
+                 "base_rps": args.serve_rps,
+                 # burst/diurnal modulation lifts the true offered rate
+                 # above base_rps (~1.4x at the defaults); record it so
+                 # utilization reads straight off the artifact
+                 "effective_rps": round(n / float(arrivals[-1]), 2),
+                 **{k: trace["meta"][k]
+                    for k in ("diurnal_period_s", "diurnal_amplitude",
+                              "burst_factor", "burst_period_s",
+                              "burst_duty", "size_tail_alpha",
+                              "n_tenants")}},
+        "cores": _available_cores(),
+        "telemetry": {**telemetry.snapshot(), "serve": snapshots},
+    }
+    if controller is not None:
+        payload["autoscale"] = {
+            "max_replicas": args.serve_autoscale_max,
+            "decisions": [{"target": d["target"], "reason": d["reason"],
+                           "resolved": d["resolved"]}
+                          for d in controller.decisions],
+        }
+    return payload
 
 
 def _shape_structs(tree):
@@ -1338,7 +1544,54 @@ def main(argv=None) -> int:
     parser.add_argument("--jaxenv-max-degree", type=int, default=8)
     parser.add_argument("--serve-requests", type=int, default=256)
     parser.add_argument("--serve-rps", type=float, default=200.0,
-                        help="offered load (Poisson arrivals/sec)")
+                        help="offered load (arrivals/sec; trace mode's "
+                             "base rate before diurnal/burst "
+                             "modulation)")
+    parser.add_argument("--load", choices=("poisson", "trace"),
+                        default="poisson",
+                        help="serve mode's arrival process: poisson "
+                             "(constant-rate) or trace (seeded "
+                             "open-loop trace with diurnal cycle, "
+                             "bursts, heavy-tailed job sizes and "
+                             "tenants — ddls_tpu.serve.loadgen)")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="serve mode: PolicyServer replicas behind "
+                             "the fleet Router (>1, or --load trace, "
+                             "selects the fleet path)")
+    parser.add_argument("--load-seed", type=int, default=1,
+                        help="arrival-process seed; recorded with the "
+                             "trace fingerprint in the JSON line")
+    parser.add_argument("--slo-ms", type=float, default=50.0,
+                        help="latency budget for SLO attainment / "
+                             "goodput (measured from SCHEDULED "
+                             "arrival — coordinated-omission-correct)")
+    parser.add_argument("--serve-routing",
+                        choices=("affinity", "least_loaded",
+                                 "round_robin", "hash"),
+                        default="affinity")
+    parser.add_argument("--serve-quota-rps", type=float, default=0.0,
+                        help="per-tenant token-bucket admission rate "
+                             "(trace mode; 0 disables quotas)")
+    parser.add_argument("--serve-autoscale", action="store_true",
+                        help="run the telemetry-driven autoscaler "
+                             "control loop during the serve bench")
+    parser.add_argument("--serve-autoscale-max", type=int, default=4)
+    parser.add_argument("--serve-autoscale-interval-s", type=float,
+                        default=0.25)
+    parser.add_argument("--trace-diurnal-period-s", type=float,
+                        default=None,
+                        help="default: half the expected trace "
+                             "duration")
+    parser.add_argument("--trace-diurnal-amplitude", type=float,
+                        default=0.5)
+    parser.add_argument("--trace-burst-factor", type=float, default=3.0)
+    parser.add_argument("--trace-burst-period-s", type=float,
+                        default=None,
+                        help="default: a quarter of the expected trace "
+                             "duration")
+    parser.add_argument("--trace-burst-duty", type=float, default=0.2)
+    parser.add_argument("--trace-size-alpha", type=float, default=1.5)
+    parser.add_argument("--trace-tenants", type=int, default=4)
     parser.add_argument("--serve-max-batch", type=int, default=8)
     parser.add_argument("--serve-deadline-ms", type=float, default=5.0)
     parser.add_argument("--serve-max-queue", type=int, default=64)

@@ -448,6 +448,12 @@ class PolicyServer:
         self.clock = clock
         self.stats = ServeStats()
         self.degraded = False
+        # fleet lifecycle flags (ISSUE 8): ``draining`` tells a Router to
+        # stop routing here while queued work finishes normally (policy
+        # answers, never a mid-swap degraded latch); ``closed`` rejects
+        # new submits after close()
+        self.draining = False
+        self.closed = False
         self._next_id = 0
         self._ready: List[ServeResponse] = []
         self._submit_time: Dict[int, float] = {}
@@ -471,6 +477,8 @@ class PolicyServer:
         microbatch flushes. Raises ``ValueError`` (before any state
         changes) for an obs missing required keys or mis-shaped — data
         errors belong to the submitting caller, never to the batch."""
+        if self.closed:
+            raise RuntimeError("PolicyServer is closed")
         _validate_obs(obs, self._obs_widths)
         now = self.clock() if now is None else now
         rid = self._next_id
@@ -537,6 +545,65 @@ class PolicyServer:
 
     def queued(self) -> int:
         return self.engine.queued()
+
+    # ------------------------------------------------------- fleet lifecycle
+    def begin_drain(self) -> None:
+        """Stop being a routing target (the fleet Router consults
+        ``draining``); queued work keeps flushing normally via ``poll``.
+        Already-admitted requests MUST still be answered on the normal
+        path — a draining replica never latches degraded and never
+        drops (ISSUE 8 satellite)."""
+        self.draining = True
+
+    def end_drain(self) -> None:
+        self.draining = False
+
+    def swap_params(self, params, now: Optional[float] = None) -> None:
+        """Checkpoint hot-swap, drain-then-swap: everything already
+        admitted is force-flushed and answered by the OLD params (policy
+        answers — a swap must never produce dropped or degraded-mode
+        decisions), the answers stay queued for the caller's next
+        ``poll``, then the forward's params are replaced in place. The
+        compiled bucket programs are shape-keyed, so the swap costs no
+        recompile."""
+        # drain FIRST, then re-park: ``poll`` rebinds ``_ready`` to a
+        # fresh list, so extending the pre-drain binding would strand
+        # the answers in an orphaned object
+        pending = self.drain(now=now)
+        self._ready.extend(pending)
+        self._forward.params = params
+
+    def reconfigure_buckets(self, buckets: Sequence[BucketSpec],
+                            now: Optional[float] = None) -> None:
+        """Bucket-ladder re-fit: drain (old ladder answers everything
+        already admitted), then rebuild the bucketer + microbatch queues
+        on the new ladder. New buckets compile on their first flush;
+        stats/degraded state carry over untouched."""
+        pending = self.drain(now=now)  # see swap_params: drain rebinds
+        self._ready.extend(pending)
+        eng = self.engine
+        self.bucketer = ObsBucketer(
+            buckets, reuse_arenas=True,
+            max_pool_per_bucket=max(int(eng.max_queue), 1))
+        self.engine = MicrobatchEngine(len(self.bucketer.buckets),
+                                       max_batch=eng.max_batch,
+                                       deadline_s=eng.deadline_s,
+                                       max_queue=eng.max_queue)
+
+    def close(self, now: Optional[float] = None) -> List[ServeResponse]:
+        """Drain-aware, idempotent shutdown: the first call answers every
+        already-admitted request (forced flush — policy answers, plus
+        anything already resolved and unfetched) and returns those
+        responses; later calls return ``[]`` and change nothing. New
+        submits raise after close. Safe under the fleet's concurrent
+        lifecycle (autoscaler retire racing a router close: whichever
+        runs first does the drain, the other is a no-op)."""
+        if self.closed:
+            return []
+        self.draining = True
+        responses = self.drain(now=now)
+        self.closed = True
+        return responses
 
     # --------------------------------------------------------------- internal
     def _run_batch(self, bucket_idx: int, reqs: List[PendingRequest],

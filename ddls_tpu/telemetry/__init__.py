@@ -40,7 +40,8 @@ from typing import Any, Dict, Optional, Sequence
 from ddls_tpu.telemetry.metrics import (DEFAULT_LATENCY_BUCKETS_S,
                                         DEFAULT_WINDOW, NULL_SPAN, Counter,
                                         Gauge, Histogram, NullSpan,
-                                        Registry, Span, overlap_summary,
+                                        Registry, Span, aggregate_snapshots,
+                                        overlap_summary,
                                         percentile_from_bucket_counts)
 from ddls_tpu.telemetry.sink import JsonlSink
 
@@ -48,6 +49,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span", "NullSpan",
     "NULL_SPAN", "JsonlSink", "DEFAULT_LATENCY_BUCKETS_S",
     "DEFAULT_WINDOW", "percentile_from_bucket_counts", "overlap_summary",
+    "aggregate_snapshots",
     "registry", "enabled", "enable", "disable", "span", "inc", "observe",
     "set_gauge", "record_event", "snapshot", "span_summaries", "reset",
     "dump_snapshot", "clock_now", "record_span", "span_intervals",

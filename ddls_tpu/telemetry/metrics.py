@@ -520,6 +520,81 @@ class Registry:
             sink.write({"type": "snapshot", "data": data})
 
 
+def aggregate_snapshots(snaps: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge several ``Registry.snapshot()`` dicts into one fleet-level
+    rollup (ISSUE 8: N serving replicas each keep a PRIVATE always-on
+    registry; the bench/report surface needs the fleet total without the
+    replicas ever sharing live metric objects).
+
+    Exact merges only: counters and gauges sum, histogram ``count`` /
+    ``sum`` / ``min`` / ``max`` and the fixed bucket counts add (the
+    bucket counts are lifetime-exact by design — docs/telemetry.md), and
+    the merged percentiles are reconstructed by bucket interpolation
+    (``percentile_from_bucket_counts``) because trailing sample windows
+    cannot be merged order-faithfully across registries. Span summaries
+    merge count/total/mean/max the same way; their percentiles are
+    dropped (window-only). Empty sections are omitted, mirroring
+    ``snapshot()``.
+    """
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    spans: Dict[str, Dict[str, float]] = {}
+    for snap in snaps:
+        if not snap:
+            continue
+        for name, value in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + int(value)
+        for name, value in (snap.get("gauges") or {}).items():
+            if value is not None:
+                gauges[name] = gauges.get(name, 0.0) + float(value)
+        for name, summ in (snap.get("histograms") or {}).items():
+            if not summ.get("count"):
+                continue
+            agg = hists.setdefault(name, {
+                "count": 0, "sum": 0.0, "min": None, "max": None,
+                "buckets": {}})
+            agg["count"] += int(summ["count"])
+            agg["sum"] += float(summ.get("sum", 0.0))
+            for bound, n in (summ.get("buckets") or {}).items():
+                agg["buckets"][bound] = (agg["buckets"].get(bound, 0)
+                                         + int(n))
+            for key, pick in (("min", min), ("max", max)):
+                v = summ.get(key)
+                if v is not None:
+                    agg[key] = (v if agg[key] is None
+                                else pick(agg[key], v))
+        for name, summ in (snap.get("spans") or {}).items():
+            agg = spans.setdefault(name, {"count": 0, "total_s": 0.0,
+                                          "max_ms": 0.0})
+            agg["count"] += int(summ.get("count", 0))
+            agg["total_s"] += float(summ.get("total_s", 0.0))
+            agg["max_ms"] = max(agg["max_ms"],
+                                float(summ.get("max_ms", 0.0)))
+    for agg in hists.values():
+        agg["mean"] = agg["sum"] / agg["count"]
+        bounds = sorted(float(b) for b in agg["buckets"] if b != "+inf")
+        cnts = [agg["buckets"].get(repr(b), agg["buckets"].get(str(b), 0))
+                for b in bounds]
+        cnts.append(agg["buckets"].get("+inf", 0))
+        for q in (50, 95, 99):
+            agg[f"p{q}"] = percentile_from_bucket_counts(
+                bounds, cnts, q, lo=agg["min"], hi=agg["max"])
+    for agg in spans.values():
+        if agg["count"]:
+            agg["mean_ms"] = agg["total_s"] / agg["count"] * 1e3
+    out: Dict[str, Any] = {}
+    if counters:
+        out["counters"] = counters
+    if gauges:
+        out["gauges"] = gauges
+    if hists:
+        out["histograms"] = hists
+    if spans:
+        out["spans"] = spans
+    return out
+
+
 def overlap_summary(intervals: Sequence[Tuple[str, float, float]],
                     prefix: Optional[str] = None,
                     top_gaps: int = 3) -> Dict[str, Any]:

@@ -28,6 +28,9 @@ ALLOWANCE = {
     "ddls_tpu/telemetry/metrics.py": 1,
     # docstring mention + PolicyServer's injectable default clock
     "ddls_tpu/serve/server.py": 2,
+    # Router's and build_fleet's injectable default clocks (shared with
+    # every replica — same discipline as PolicyServer's)
+    "ddls_tpu/serve/fleet.py": 2,
     # RolloutCollector's one-shot adaptive pipeline decision (control
     # flow that must work with telemetry disabled, never reported)
     "ddls_tpu/rl/rollout.py": 4,

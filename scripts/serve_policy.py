@@ -15,10 +15,16 @@ emits one line::
     {"id": "job-17", "action": 8, "source": "policy", "reason": "batched",
      "bucket": 1, "latency_ms": 3.2}
 
-Requests microbatch through ``ddls_tpu.serve.PolicyServer`` (flush on fill
-or deadline; heuristic ``FixedDegreePacking`` fallback when the queue
-saturates, a graph fits no bucket, or the device backend fails). A summary
-JSON line with the serving counters lands on stderr at EOF.
+Requests route through the fleet ``Router`` (``ddls_tpu.serve.fleet``)
+into ``--replicas N`` PolicyServers — one by default, so the protocol
+and answer bits match the single-server stack exactly — each
+microbatching per bucket (flush on fill or deadline; heuristic
+``FixedDegreePacking`` fallback when the queue saturates, a graph fits
+no bucket, or the device backend fails). An optional ``tenant`` request
+field feeds consistent-hash affinity routing and, with ``--quota-rps``,
+per-tenant token-bucket admission (quota sheds answer ``action: null``,
+``source: "shed"``). A summary JSON line with the fleet counters lands
+on stderr at EOF.
 
 ``--selftest`` runs the whole pipeline end-to-end on a synthetic dataset
 (CPU-pinned, no TPU probe): real env observations through the bucketed
@@ -84,16 +90,27 @@ def build_model_from_config(config_path, config_name, overrides):
     return _build(config_path, config_name, overrides)
 
 
-def make_server(args, model, params, graph_feature_dim=None):
+def make_fleet(args, model, params, graph_feature_dim=None):
+    """The stdin front end serves through the fleet Router (ISSUE 8) —
+    one replica by default, so the stdout protocol and answer bits are
+    exactly the single-server path's; ``--replicas N`` scales out with
+    each replica compiling its own bucket ladder. Quota shedding only
+    arms when ``--quota-rps`` is set (a shed answers ``action: null``
+    with ``source: "shed"`` — clients opting into quotas opt into
+    refusals)."""
     from ddls_tpu.envs.baselines import FixedDegreePacking
-    from ddls_tpu.serve import PolicyServer
+    from ddls_tpu.serve import build_fleet
 
     buckets = None
     if args.buckets:
         buckets = [tuple(int(x) for x in b.split("x"))
                    for b in args.buckets.split(",")]
-    return PolicyServer(
-        model, params, buckets=buckets,
+    return build_fleet(
+        model, params, n_replicas=args.replicas, routing=args.routing,
+        shed_enabled=bool(args.quota_rps),
+        quota_rps=args.quota_rps or None,
+        quota_burst=args.quota_burst or None,
+        buckets=buckets,
         max_nodes=args.max_nodes, max_batch=args.max_batch,
         deadline_s=args.deadline_ms / 1e3, max_queue=args.max_queue,
         graph_feature_dim=graph_feature_dim,
@@ -195,6 +212,23 @@ def main(argv=None) -> int:
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--deadline-ms", type=float, default=10.0)
     parser.add_argument("--max-queue", type=int, default=64)
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="PolicyServer replicas behind the fleet "
+                             "Router (each compiles its own bucket "
+                             "ladder; stdout protocol unchanged)")
+    parser.add_argument("--routing",
+                        choices=("affinity", "least_loaded",
+                                 "round_robin", "hash"),
+                        default="affinity",
+                        help="fleet routing policy (affinity = "
+                             "consistent-hash on the request's "
+                             "'tenant' field, least-loaded otherwise)")
+    parser.add_argument("--quota-rps", type=float, default=0.0,
+                        help="per-tenant token-bucket admission rate; "
+                             "0 disables quotas (quota sheds answer "
+                             "action null, source 'shed')")
+    parser.add_argument("--quota-burst", type=float, default=0.0,
+                        help="quota burst size (default: --quota-rps)")
     parser.add_argument("--degree", type=int, default=8,
                         help="FixedDegreePacking fallback degree (8 = the "
                              "canonical 32-server extraction)")
@@ -277,7 +311,7 @@ def main(argv=None) -> int:
                 args.max_nodes, args.max_nodes * 2, n_actions,
                 graph_dim).items()})
 
-    server = make_server(args, model, params, graph_feature_dim=graph_dim)
+    server = make_fleet(args, model, params, graph_feature_dim=graph_dim)
     rid_to_client: dict = {}
 
     def emit_responses(responses) -> None:
@@ -296,9 +330,11 @@ def main(argv=None) -> int:
         client_id = None
         try:
             obj = json.loads(line)
+            tenant = None
             if isinstance(obj, dict):
                 client_id = obj.get("id")
-            rid = server.submit(obs_from_json(obj["obs"]))
+                tenant = obj.get("tenant")
+            rid = server.submit(obs_from_json(obj["obs"]), tenant=tenant)
             rid_to_client[rid] = (client_id if client_id is not None
                                   else rid)
         except Exception as exc:
@@ -317,23 +353,35 @@ def main(argv=None) -> int:
 
     # --stats-interval bookkeeping: the periodic line goes to STDERR (the
     # stdout JSON protocol carries only decisions), decisions/s is over
-    # the interval window, everything else reads the live stats
+    # the interval window, everything else reads the live fleet stats —
+    # fleet-level p99/fallback plus one column per replica (queue depth,
+    # batch occupancy, degraded flag)
     def stats_line(window_done: int, window_s: float) -> str:
-        s = server.stats.summary()
-        p99 = s["p99_latency_ms"]
+        snap = server.autoscale_snapshot()
+        p99 = snap["p99_latency_ms"]
         p99_txt = "n/a" if p99 is None else f"{p99:.2f} ms"
-        occ = " ".join(
-            f"b{idx}={val:.2f}" for idx, val in
-            sorted(server.stats.per_bucket_occupancy().items()))
+        n_req = n_fb = 0
+        for rep in server.replica_set.replicas:
+            n_req += rep.server.stats.n_requests
+            n_fb += rep.server.stats.n_fallback
+        summ = server.summary()
+        cols = []
+        for rid, s in sorted(summ["per_replica"].items()):
+            occ = s["batch_occupancy"]
+            cols.append(
+                f"{rid} q={s['queued']}"
+                f" occ={'-' if occ is None else format(occ, '.2f')}"
+                + (" degraded" if s["degraded"] else ""))
         return (f"[serve] {window_done / max(window_s, 1e-9):.1f} dec/s"
                 f" | p99 {p99_txt}"
-                f" | fallback {s['fallback_rate'] * 100:.1f}%"
-                f" | occupancy {occ or '-'}"
+                f" | fallback {(n_fb / n_req if n_req else 0) * 100:.1f}%"
+                f" | shed {summ['shed_rate'] * 100:.1f}%"
                 f" | queued {server.queued()}"
-                f" | degraded {server.degraded}")
+                f" | " + " | ".join(cols))
 
     def decisions_done() -> int:
-        return server.stats.n_policy + server.stats.n_fallback
+        return sum(rep.server.stats.n_policy + rep.server.stats.n_fallback
+                   for rep in server.replica_set.replicas)
 
     fd = sys.stdin.fileno()
     lines_in = LineAssembler()
@@ -370,13 +418,14 @@ def main(argv=None) -> int:
             last_stats_t = now
             last_stats_done = done
     emit_responses(server.drain())
-    print(json.dumps({"serve_stats": server.stats.summary()}),
+    print(json.dumps({"serve_stats": server.summary()}),
           file=sys.stderr, flush=True)
     if telemetry.enabled():
-        # sink gets the final global + per-server registries (the record
-        # scripts/telemetry_report.py reads counters/histograms from)
+        # sink gets the final global + per-replica registries plus the
+        # fleet aggregate (the record scripts/telemetry_report.py reads
+        # counters/histograms from)
         telemetry.dump_snapshot(
-            extra={"serve": server.stats.registry.snapshot()})
+            extra={"serve": server.registry_snapshots()})
     return 0
 
 

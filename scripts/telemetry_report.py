@@ -171,6 +171,47 @@ def _flight_section(flight_events: List[dict]) -> List[str]:
     return lines + [""]
 
 
+def _fleet_section(serve: Dict[str, Any]) -> List[str]:
+    """Per-replica comparison when the snapshot's ``serve`` subtree
+    carries a fleet dump (``r<id>`` replica registries + the
+    ``aggregate`` multi-registry merge — serve/fleet.py
+    ``registry_snapshots``): one row per replica plus the exact
+    aggregate row, so replica imbalance is readable at a glance."""
+    replicas = {k: v for k, v in serve.items()
+                if k.startswith("r") and k[1:].isdigit()
+                and isinstance(v, dict)}
+    if len(replicas) < 2:
+        return []
+
+    def row(name, snap):
+        counters = snap.get("counters") or {}
+        hists = snap.get("histograms") or {}
+        lat = _histogram_percentiles(hists.get("serve.latency_s", {})) \
+            if hists.get("serve.latency_s") else {}
+        occ = hists.get("serve.batch_occupancy", {})
+
+        def cell(v, scale=1.0):
+            return "n/a" if v is None else f"{v * scale:.3f}"
+
+        return (f"{name:<12}{counters.get('serve.requests', 0):>10}"
+                f"{counters.get('serve.policy', 0):>10}"
+                f"{counters.get('serve.fallback', 0):>10}"
+                f"{cell(lat.get('p50'), 1e3):>12}"
+                f"{cell(lat.get('p99'), 1e3):>12}"
+                f"{cell(occ.get('mean') if occ.get('count') else None):>12}")
+
+    lines = ["== serving fleet (per-replica registries) ==",
+             f"{'replica':<12}{'requests':>10}{'policy':>10}"
+             f"{'fallback':>10}{'p50_ms':>12}{'p99_ms':>12}"
+             f"{'occupancy':>12}"]
+    for name in sorted(replicas, key=lambda r: int(r[1:])):
+        lines.append(row(name, replicas[name]))
+    agg = serve.get("aggregate")
+    if isinstance(agg, dict):
+        lines.append(row("aggregate", agg))
+    return lines + [""]
+
+
 def render_report(path: str) -> List[str]:
     span_durations: Dict[str, List[float]] = defaultdict(list)
     span_intervals: List[tuple] = []
@@ -226,6 +267,8 @@ def render_report(path: str) -> List[str]:
             lines.append(f"{kind:<24}{str(phase):<18}{count:>7}  "
                          f"{json.dumps(last)}")
         lines += [""]
+    if isinstance(last_snapshot.get("serve"), dict):
+        lines += _fleet_section(last_snapshot["serve"])
     if last_snapshot:
         sections = _walk_snapshot(last_snapshot)
         if sections.get("counters"):

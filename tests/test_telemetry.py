@@ -228,6 +228,82 @@ def test_env_hot_loop_disabled_guard(dataset_dir, monkeypatch):
     assert created["n"] > 0
 
 
+def test_fleet_serving_burst_disabled_guard(monkeypatch):
+    """ISSUE 8 satellite: the whole fleet stack — Router admission/
+    routing/quotas/shedding, loadgen trace generation, hot-swap,
+    autoscaler decide + apply — keeps every stat on PRIVATE always-on
+    registries and creates ZERO global metrics while telemetry is
+    disabled (counted by intercepting the global registry's
+    metric-creating calls, like the env hot-loop guard above)."""
+    reg = telemetry.registry()
+    created = {"n": 0}
+    for factory in ("counter", "gauge", "histogram", "span"):
+        orig = getattr(reg, factory)
+
+        def counting(*a, _orig=orig, **k):
+            created["n"] += 1
+            return _orig(*a, **k)
+
+        monkeypatch.setattr(reg, factory, counting)
+
+    import jax.numpy as jnp
+
+    from ddls_tpu.serve import (Autoscaler, AutoscaleConfig,
+                                AutoscaleController, build_fleet, loadgen)
+
+    n_actions = 9
+
+    def stub_apply(params, obs):
+        b = obs["node_features"].shape[0]
+        return jnp.zeros((b, n_actions)), jnp.zeros((b,))
+
+    rng = np.random.RandomState(0)
+    obs = {
+        "action_set": np.arange(n_actions, dtype=np.int32),
+        "action_mask": np.ones(n_actions, np.int32),
+        "node_features": rng.uniform(0, 1, (8, 5)).astype(np.float32),
+        "edge_features": rng.uniform(0, 1, (12, 2)).astype(np.float32),
+        "graph_features": rng.uniform(0, 1, (26,)).astype(np.float32),
+        "edges_src": np.zeros(12, np.int32),
+        "edges_dst": np.zeros(12, np.int32),
+        "node_split": np.array([8], np.int32),
+        "edge_split": np.array([12], np.int32),
+    }
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    assert not telemetry.enabled()
+    router = build_fleet(None, {}, n_replicas=2, shed_enabled=True,
+                         quota_rps=5.0, clock=Clock(),
+                         buckets=[(8, 12)], max_batch=4,
+                         deadline_s=0.005, max_queue=8,
+                         apply_fn=stub_apply)
+    trace = loadgen.generate_trace(n_requests=24, base_rps=100.0,
+                                   seed=0, diurnal_period_s=0.12,
+                                   burst_period_s=0.06)
+    ctl = AutoscaleController(router, Autoscaler(AutoscaleConfig(
+        max_replicas=3, cooldown=1)))
+    for t, tenant in zip(trace["arrival_s"], trace["tenant"]):
+        router.submit(obs, now=float(t), tenant=tenant)
+        router.poll(now=float(t))
+    ctl.step(now=1.0)
+    router.hot_swap({}, now=1.0)
+    router.refit_buckets(n_buckets=1, now=1.0)
+    router.drain(now=1.0)
+    router.summary()
+    router.registry_snapshots()
+    router.close(now=1.0)
+
+    assert created["n"] == 0
+    assert telemetry.snapshot() == {}
+    # ...while the PRIVATE registries did record the burst
+    assert dict(router.registry.counter_items())["fleet.requests"] == 24
+
+
 # ------------------------------------------------------------- probe events
 def test_probe_outcomes_recorded():
     import bench
