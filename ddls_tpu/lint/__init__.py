@@ -1,0 +1,43 @@
+"""Invariant lint engine: one AST pass, all contract rules (ISSUE 9).
+
+The codebase's correctness rests on invariants that used to live only in
+CLAUDE.md prose and three standalone checker scripts: metrics-are-futures
+on the collect->update path, process-consistent multi-host collective
+gates, one-bool telemetry/flight gating, the flow-mask predicate ban,
+frozen checkpoint param-tree names, and the host<->jitted backend surface
+sync. This package makes them mechanical: every ``.py`` file under
+``ddls_tpu/`` is parsed ONCE and every registered rule runs over the
+shared AST (plus a few cross-file compare passes), so adding an invariant
+is adding a rule plugin, not another 100-line walker script.
+
+Entry points
+------------
+* ``python scripts/lint.py`` — whole-tree run, text or ``--json`` output,
+  rc 0/1 (tier-1: tests/test_lint.py runs it over the real tree).
+* ``scripts/check_no_bare_timers.py`` / ``check_flight_gated.py`` /
+  ``check_shm_unlink.py`` — thin shims that run their single ported rule
+  with the legacy CLI surface (``--paths``, same rc) so existing tests
+  and docs references keep working.
+* ``run_lint(...)`` — in-process API (what the tests use).
+
+Suppressions and allowlists
+---------------------------
+Inline: ``# ddls-lint: allow(rule-id) -- <why>`` on the finding's line;
+the reason is MANDATORY (a bare ``allow(...)`` is itself a lint error).
+Per-rule allowlists live in ONE place, the ``[tool.ddls_lint]`` table in
+``pyproject.toml``; stale entries (files or functions that no longer
+exist) are themselves lint errors so allowances cannot rot. See
+docs/lint.md for the rule catalog and how to add a rule.
+"""
+from __future__ import annotations
+
+from ddls_tpu.lint.core import (Config, Context, Finding, LintResult,
+                                Rule, SourceFile, load_config)
+from ddls_tpu.lint.engine import main, render_json, render_text, run_lint
+from ddls_tpu.lint.rules import ALL_RULES, get_rules
+
+__all__ = [
+    "ALL_RULES", "Config", "Context", "Finding", "LintResult", "Rule",
+    "SourceFile", "get_rules", "load_config", "main", "render_json",
+    "render_text", "run_lint",
+]

@@ -1,0 +1,350 @@
+"""Lint engine primitives: findings, parsed sources, config, suppressions.
+
+Everything here is deliberately dependency-free (stdlib ``ast`` + a TOML
+reader): the engine runs as a tier-1 guard on the CPU box and must never
+drag jax into a lint invocation.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: inline suppression syntax — the reason after ``--`` is mandatory:
+#: ``# ddls-lint: allow(rule-id[, rule-id...]) -- <why this is deliberate>``
+SUPPRESS_RE = re.compile(
+    r"#\s*ddls-lint:\s*allow\(([^)]*)\)\s*(?:--\s*(.*\S))?\s*$")
+
+
+@dataclass
+class Finding:
+    """One rule violation (or engine-level error) at ``rel``:``line``."""
+
+    rule: str
+    rel: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: Optional[str] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "file": self.rel, "line": self.line,
+                "message": self.message, "suppressed": self.suppressed,
+                "suppress_reason": self.suppress_reason}
+
+    def __str__(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.rel}:{self.line}: [{self.rule}] {self.message}{tag}"
+
+
+class SourceFile:
+    """A file parsed exactly once; every rule reads this shared view."""
+
+    def __init__(self, path: str, rel: str):
+        self.path = path
+        self.rel = rel
+        with open(path, encoding="utf-8", errors="replace") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.Module] = ast.parse(self.text)
+        except SyntaxError as e:  # surfaced as a parse-error finding
+            self.tree = None
+            self.parse_error = e
+        # line -> (frozenset of rule ids or {"*"}, reason or None)
+        self.suppressions: Dict[int, Tuple[frozenset, Optional[str]]] = {}
+        #: (line, ids the bad comment names — empty if none, message);
+        #: the ids let a restricted run skip other rules' suppressions
+        self.bad_suppressions: List[Tuple[int, frozenset, str]] = []
+        for lineno, line in enumerate(self.lines, start=1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            ids = frozenset(p.strip() for p in m.group(1).split(",")
+                            if p.strip())
+            reason = m.group(2)
+            if not ids:
+                self.bad_suppressions.append(
+                    (lineno, ids, "suppression names no rule id: "
+                                  "`# ddls-lint: allow(rule-id) -- "
+                                  "reason`"))
+                continue
+            if not reason:
+                self.bad_suppressions.append(
+                    (lineno, ids,
+                     "suppression without a reason — the reason "
+                     "is mandatory: `# ddls-lint: allow("
+                     + ", ".join(sorted(ids)) + ") -- <why>`"))
+                continue
+            self.suppressions[lineno] = (ids, reason)
+        self._qualname_spans: Optional[List[Tuple[str, int, int]]] = None
+
+    # ------------------------------------------------------------ helpers
+    def suppression_for(self, rule_id: str,
+                        line: int) -> Optional[str]:
+        """The reason string if ``rule_id`` is allowed on ``line``."""
+        entry = self.suppressions.get(line)
+        if entry is None:
+            return None
+        ids, reason = entry
+        if rule_id in ids or "*" in ids:
+            return reason
+        return None
+
+    def qualname_spans(self) -> List[Tuple[str, int, int]]:
+        """(qualname, first line, last line) for every function/method,
+        innermost-last, e.g. ``RLEpochLoop._harvest_metrics``."""
+        if self._qualname_spans is None:
+            spans: List[Tuple[str, int, int]] = []
+
+            def walk(node, prefix: str) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        name = prefix + child.name
+                        spans.append((name, child.lineno,
+                                      child.end_lineno or child.lineno))
+                        walk(child, name + ".")
+                    elif isinstance(child, ast.ClassDef):
+                        name = prefix + child.name
+                        spans.append((name, child.lineno,
+                                      child.end_lineno or child.lineno))
+                        walk(child, name + ".")
+                    else:
+                        walk(child, prefix)
+
+            if self.tree is not None:
+                walk(self.tree, "")
+            self._qualname_spans = spans
+        return self._qualname_spans
+
+    def enclosing_qualname(self, line: int) -> Optional[str]:
+        """Innermost function/method qualname containing ``line``."""
+        best: Optional[Tuple[int, str]] = None
+        for name, lo, hi in self.qualname_spans():
+            if lo <= line <= hi and (best is None or lo >= best[0]):
+                best = (lo, name)
+        return best[1] if best else None
+
+    def has_qualname(self, qualname: str) -> bool:
+        return any(name == qualname for name, _, _ in self.qualname_spans())
+
+
+class Config:
+    """The ``[tool.ddls_lint]`` table (one consolidated allowlist home)."""
+
+    def __init__(self, table: Optional[Dict[str, Any]] = None):
+        self.table: Dict[str, Any] = dict(table or {})
+
+    def rule(self, rule_id: str) -> Dict[str, Any]:
+        value = self.table.get(rule_id)
+        return dict(value) if isinstance(value, dict) else {}
+
+
+def load_config(repo_root: str) -> Config:
+    """Read ``[tool.ddls_lint]`` from ``<repo_root>/pyproject.toml``."""
+    path = os.path.join(repo_root, "pyproject.toml")
+    if not os.path.exists(path):
+        return Config()
+    try:
+        import tomllib  # Python >= 3.11
+    except ImportError:  # Python 3.10: the vendored-everywhere fallback
+        import tomli as tomllib  # type: ignore[no-redef]
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    return Config(data.get("tool", {}).get("ddls_lint", {}))
+
+
+@dataclass
+class Context:
+    """Shared state for one engine run: every parsed file + the config."""
+
+    repo_root: str
+    config: Config
+    files: Dict[str, SourceFile] = field(default_factory=dict)
+
+    def get(self, rel: str) -> Optional[SourceFile]:
+        return self.files.get(rel.replace(os.sep, "/"))
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    @property
+    def returncode(self) -> int:
+        return 1 if self.errors else 0
+
+
+class Rule:
+    """A lint rule plugin.
+
+    Subclasses set ``id`` (kebab-case, what suppressions and the config
+    table key on), ``pointer`` (the one-line fix hint printed under the
+    findings) and ``scope_dirs`` (repo-relative dir prefixes the rule
+    inspects; files OUTSIDE the repo package — fixture trees under
+    ``--paths`` — are always in scope, mirroring the legacy checkers).
+    ``check_file`` runs per parsed file; ``check_tree`` runs once per
+    engine invocation for cross-file compares and allowlist validation.
+    """
+
+    id: str = ""
+    pointer: str = ""
+    #: None = every scanned file; otherwise repo-relative dir prefixes
+    scope_dirs: Optional[Tuple[str, ...]] = None
+
+    def in_scope(self, rel: str) -> bool:
+        if self.scope_dirs is None:
+            return True
+        if not rel.startswith("ddls_tpu/"):
+            return True  # fixture trees outside the package
+        return any(rel.startswith(d) for d in self.scope_dirs)
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        return []
+
+    def check_tree(self, ctx: Context) -> List[Finding]:
+        return []
+
+    # -------------------------------------------------- shared validators
+    def validate_allow_keys(self, ctx: Context, entries: Dict[str, Any],
+                            want_qualname: bool = False,
+                            table: str = "", entity: str = "function",
+                            want_int: bool = False) -> List[Finding]:
+        """Stale-allowance guard: every ``path`` (or ``path::qualname``)
+        key in a config allowlist must still resolve, every string value
+        must carry a non-empty written reason, and (``want_int``) count
+        values must be integers — stale or malformed entries are
+        themselves lint errors (they rot otherwise). ``table`` names a
+        sub-table suffix (e.g. ``.classes``); ``entity`` is the noun for
+        qualname findings (function/class)."""
+        label = f"[tool.ddls_lint.{self.id}{table}]"
+        findings = []
+        for key, reason in entries.items():
+            rel, _, qual = key.partition("::")
+            rel = rel.replace(os.sep, "/")
+            if not os.path.exists(os.path.join(ctx.repo_root, rel)):
+                findings.append(Finding(
+                    self.id, "pyproject.toml", 1,
+                    f"stale {label} allowance: "
+                    f"{rel!r} does not exist — remove the entry"))
+                continue
+            if want_qualname:
+                if not qual:
+                    findings.append(Finding(
+                        self.id, "pyproject.toml", 1,
+                        f"{label} allowance {key!r} "
+                        "must be 'path::qualname'"))
+                    continue
+                sf = ctx.get(rel)
+                if sf is not None and not sf.has_qualname(qual):
+                    findings.append(Finding(
+                        self.id, "pyproject.toml", 1,
+                        f"stale {label} allowance: "
+                        f"no {entity} {qual!r} in {rel} — remove or "
+                        "update the entry"))
+            if want_int and not (isinstance(reason, int)
+                                 and not isinstance(reason, bool)):
+                findings.append(Finding(
+                    self.id, "pyproject.toml", 1,
+                    f"{label} allowance {key!r} must be an integer "
+                    f"occurrence count (got {type(reason).__name__})"))
+            if isinstance(reason, str) and not reason.strip():
+                findings.append(Finding(
+                    self.id, "pyproject.toml", 1,
+                    f"{label} allowance {key!r} has "
+                    "an empty reason — the written reason is mandatory"))
+        return findings
+
+    @staticmethod
+    def int_allowance(entries: Dict[str, Any], rel: str) -> int:
+        """The integer allowance for ``rel``, 0 when absent or malformed
+        (a malformed value is reported by ``validate_allow_keys(...,
+        want_int=True)`` — the per-file pass must not crash on it)."""
+        value = entries.get(rel, 0)
+        if isinstance(value, int) and not isinstance(value, bool):
+            return value
+        return 0
+
+    def inline_suppressed_lines(self, sf: SourceFile) -> set:
+        """Lines whose inline suppression names this rule (or ``*``)."""
+        return {line for line, (ids, _reason) in sf.suppressions.items()
+                if self.id in ids or "*" in ids}
+
+    def validate_count_allowances(self, ctx: Context,
+                                  entries: Dict[str, Any], count_of,
+                                  noun: str) -> List[Finding]:
+        """The count-based anti-rot contract, shared by bare-timers and
+        shm-unlink: an entry granting more ``noun``s than ``count_of(sf)``
+        finds is green headroom for new violations, and a file mixing a
+        config count with inline suppressions can mask which occurrence
+        is new — both are lint errors."""
+        findings = []
+        for rel in entries:
+            sf = ctx.get(rel)
+            if sf is None:  # not in the scanned roots (fixture runs)
+                continue
+            allowed = self.int_allowance(entries, rel)
+            count = count_of(sf)
+            if count < allowed:
+                findings.append(Finding(
+                    self.id, "pyproject.toml", 1,
+                    f"stale [tool.ddls_lint.{self.id}] allowance: {rel} "
+                    f"has {count} {noun}(s) but the entry grants "
+                    f"{allowed} — lower or remove it"))
+            if self.inline_suppressed_lines(sf):
+                findings.append(Finding(
+                    self.id, "pyproject.toml", 1,
+                    f"{rel} mixes a [tool.ddls_lint.{self.id}] count "
+                    "allowance with inline suppressions — use one "
+                    "mechanism (combined, a suppression can mask which "
+                    "occurrence is new)"))
+        return findings
+
+
+# --------------------------------------------------------------- AST utils
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def module_aliases(tree: ast.Module, module_suffix: str,
+                   from_name: str) -> set:
+    """Names a module binds to ``<pkg>....<module_suffix>`` — covers
+    ``import pkg.mod as x``, ``from pkg import mod as x``, relative
+    ``from .. import mod``, and plain ``import pkg.mod`` (which binds
+    the full DOTTED access path — match call sites with
+    ``dotted_name(func.value) in aliases``, not bare Names only)."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            # relative `from .. import telemetry` has module=None; a
+            # relative module name (`from ..telemetry import flight`)
+            # matches the suffix like an absolute one
+            if (node.module is None and node.level > 0) or (
+                    node.module and (node.module.endswith(module_suffix)
+                                     or node.module == module_suffix)):
+                for a in node.names:
+                    if a.name == from_name:
+                        aliases.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith(f"{module_suffix}.{from_name}"):
+                    # no asname: the binding is reached via the full
+                    # dotted path (`ddls_tpu.telemetry.inc(...)`)
+                    aliases.add(a.asname or a.name)
+    return aliases

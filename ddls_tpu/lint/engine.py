@@ -1,0 +1,196 @@
+"""Engine: discover files, parse each ONCE, run every rule, render.
+
+The whole-tree run must stay cheap (tier-1 budget: well under ~5 s on
+the CPU box): one ``os.walk`` per root, one ``ast.parse`` per file
+(``SourceFile`` caches the tree; cross-file rules read the same cache),
+zero imports of the linted code — AST compare only, so a lint run can
+never drag jax in.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from ddls_tpu.lint.core import (Config, Context, Finding, LintResult,
+                                Rule, SourceFile, load_config)
+
+#: the engine's own package — excluded from scans: rule sources quote the
+#: very tokens they hunt (fixture strings would self-flag)
+SELF_DIR = "ddls_tpu/lint/"
+
+
+def discover(roots: Sequence[str], repo_root: str) -> List[SourceFile]:
+    files: List[SourceFile] = []
+    seen = set()
+    for root in roots:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+                if rel in seen or rel.startswith(SELF_DIR):
+                    continue
+                seen.add(rel)
+                files.append(SourceFile(path, rel))
+    return files
+
+
+def run_lint(roots: Optional[Sequence[str]] = None,
+             repo_root: Optional[str] = None,
+             rules: Optional[Sequence[Rule]] = None,
+             config: Optional[Config] = None) -> LintResult:
+    """One engine pass: parse every file under ``roots`` once, run all
+    ``rules`` (default: the full registry) over the shared ASTs, apply
+    inline suppressions, and return every finding (suppressed ones
+    included, flagged — ``--json`` consumers track both)."""
+    from ddls_tpu.lint.rules import ALL_RULES
+
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    if roots is None:
+        roots = [os.path.join(repo_root, "ddls_tpu")]
+    if config is None:
+        config = load_config(repo_root)
+    if rules is None:
+        rules = ALL_RULES
+
+    ctx = Context(repo_root=repo_root, config=config)
+    for sf in discover(roots, repo_root):
+        ctx.files[sf.rel] = sf
+
+    active_ids = {rule.id for rule in rules}
+    # a suppression naming an id outside the registry suppresses
+    # nothing — flagged in EVERY run (mirrors get_rules raising on
+    # unknown --rules ids: a typo cannot silently lint nothing)
+    known_ids = {rule.id for rule in ALL_RULES} | active_ids | {"*"}
+    findings: List[Finding] = []
+
+    def flag_unknown_ids(sf: SourceFile, lineno: int, ids) -> None:
+        for rid in sorted(set(ids) - known_ids):
+            findings.append(Finding(
+                "lint-suppression", sf.rel, lineno,
+                f"suppression names unknown rule id {rid!r} (it "
+                "suppresses nothing) — available: "
+                + ", ".join(sorted(r.id for r in ALL_RULES))))
+
+    for sf in ctx.files.values():
+        if sf.parse_error is not None:
+            # always reported: an unparseable file can hide violations
+            # of ANY rule, restricted run or not
+            findings.append(Finding(
+                "parse-error", sf.rel, sf.parse_error.lineno or 0,
+                f"unparseable: {sf.parse_error.msg}"))
+            continue
+        for lineno, (ids, _reason) in sf.suppressions.items():
+            flag_unknown_ids(sf, lineno, ids)
+        for lineno, ids, message in sf.bad_suppressions:
+            flag_unknown_ids(sf, lineno, ids)
+            # a malformed suppression belongs to the rules it names — a
+            # restricted run (the single-rule legacy shims) must not
+            # fail on another rule's reasonless comment; one naming NO
+            # rule is engine-level garbage and fails every run
+            if ids and "*" not in ids and not (ids & active_ids):
+                continue
+            findings.append(Finding("lint-suppression", sf.rel, lineno,
+                                    message))
+        for rule in rules:
+            if rule.in_scope(sf.rel):
+                findings.extend(rule.check_file(sf, ctx))
+    for rule in rules:
+        findings.extend(rule.check_tree(ctx))
+
+    for f in findings:
+        sf = ctx.files.get(f.rel)
+        if sf is None or f.rule in ("parse-error", "lint-suppression"):
+            continue
+        reason = sf.suppression_for(f.rule, f.line)
+        if reason is not None:
+            f.suppressed = True
+            f.suppress_reason = reason
+
+    findings.sort(key=lambda f: (f.rel, f.line, f.rule))
+    return LintResult(findings=findings)
+
+
+# ------------------------------------------------------------- rendering
+def render_text(result: LintResult, rules: Sequence[Rule]) -> str:
+    lines: List[str] = []
+    errors = result.errors
+    if errors:
+        lines.append("lint: invariant violations found:")
+        for f in errors:
+            lines.append(f"  {f.rel}:{f.line}: [{f.rule}] {f.message}")
+        for rule in rules:
+            if rule.pointer and any(f.rule == rule.id for f in errors):
+                lines.append(f"fix({rule.id}): {rule.pointer}")
+        if any(f.rule == "lint-suppression" for f in errors):
+            lines.append("fix(lint-suppression): every `# ddls-lint: "
+                         "allow(rule)` must carry ` -- <reason>`")
+    suppressed = [f for f in result.findings if f.suppressed]
+    if suppressed:
+        lines.append(f"({len(suppressed)} finding(s) suppressed inline "
+                     "with reasons)")
+    if not errors:
+        lines.append("ok: all lint rules clean")
+    return "\n".join(lines)
+
+
+def render_json(result: LintResult) -> str:
+    return json.dumps({
+        "findings": [f.as_dict() for f in result.findings],
+        "counts": {
+            "errors": len(result.errors),
+            "suppressed": sum(f.suppressed for f in result.findings),
+        },
+        "returncode": result.returncode,
+    }, indent=2)
+
+
+# -------------------------------------------------------------------- CLI
+def main(argv: Optional[Sequence[str]] = None,
+         rule_ids: Optional[Sequence[str]] = None,
+         description: str = "ddls_tpu invariant lint engine",
+         repo_root: Optional[str] = None) -> int:
+    """CLI driver (scripts/lint.py and the three legacy shims).
+    ``rule_ids`` restricts the run (the shim surface); rc 0 clean / 1
+    findings, matching the legacy checkers."""
+    from ddls_tpu.lint.rules import get_rules
+
+    parser = argparse.ArgumentParser(description=description)
+    parser.add_argument("--paths", nargs="*", default=None,
+                        help="roots to scan (default: ddls_tpu/ in the "
+                             "repo; allowances are keyed relative to "
+                             "the repo root)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings (rule id, "
+                             "file:line, message, suppression state)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    args = parser.parse_args(argv)
+
+    ids = rule_ids
+    if args.rules:
+        ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        rules = get_rules(ids)
+    except ValueError as e:
+        # fail loud but clean: a typo'd --rules id must not dump a
+        # traceback (or break the --json machine-readable contract)
+        print(json.dumps({"error": str(e), "returncode": 2})
+              if args.json else f"lint: {e}")
+        return 2
+    result = run_lint(roots=args.paths, repo_root=repo_root, rules=rules)
+    print(render_json(result) if args.json
+          else render_text(result, rules))
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

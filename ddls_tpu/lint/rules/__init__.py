@@ -1,0 +1,47 @@
+"""Rule registry: one import surface for every lint rule plugin.
+
+Adding a rule = adding a module here with a ``Rule`` subclass and
+listing an instance in ``ALL_RULES`` (docs/lint.md "Adding a rule").
+Order is display order in the text report.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from ddls_tpu.lint.core import Rule
+from ddls_tpu.lint.rules.backend_parity import BackendSurfaceParityRule
+from ddls_tpu.lint.rules.bare_timers import BareTimersRule
+from ddls_tpu.lint.rules.flight_gated import FlightGatedRule
+from ddls_tpu.lint.rules.flow_mask import FlowMaskRule
+from ddls_tpu.lint.rules.hot_path_transfer import HotPathTransferRule
+from ddls_tpu.lint.rules.multihost_gates import MultihostGatesRule
+from ddls_tpu.lint.rules.param_tree import FrozenParamTreeRule
+from ddls_tpu.lint.rules.shm_unlink import ShmUnlinkRule
+from ddls_tpu.lint.rules.telemetry_gated import TelemetryGatedRule
+
+#: the three ported tier-1 guards first, then the six prose-invariant rules
+ALL_RULES: List[Rule] = [
+    BareTimersRule(),
+    FlightGatedRule(),
+    ShmUnlinkRule(),
+    HotPathTransferRule(),
+    MultihostGatesRule(),
+    TelemetryGatedRule(),
+    FlowMaskRule(),
+    FrozenParamTreeRule(),
+    BackendSurfaceParityRule(),
+]
+
+
+def get_rules(ids: Optional[Iterable[str]] = None) -> List[Rule]:
+    """The registered rules, optionally restricted to ``ids`` (what the
+    legacy shims use); unknown ids raise so a typo cannot silently lint
+    nothing."""
+    if ids is None:
+        return list(ALL_RULES)
+    by_id = {r.id: r for r in ALL_RULES}
+    unknown = sorted(set(ids) - set(by_id))
+    if unknown:
+        raise ValueError(
+            f"unknown lint rule(s) {unknown}; available: {sorted(by_id)}")
+    return [by_id[i] for i in ids]

@@ -1,0 +1,265 @@
+"""backend-surface-parity: the host<->jitted decision surfaces must stay
+in sync — checked by AST compare, no jax import.
+
+Three cheap cross-file compares over the shared parse (CLAUDE.md
+four-backend invariant, tests pin the VALUES — this rule pins the
+SURFACES so a rename fails at lint time, not at the first x64 parity
+run):
+
+1. The jitted env's cause-code tables (``sim/jax_env.py``):
+   ``CAUSE_*`` constants pairwise distinct, ``CAUSE_CODE_TO_STR``
+   covering every constant exactly once, string values unique
+   (bijective).
+2. Cause-string vocabulary: every non-None jitted cause string (and
+   every explicit ``CAUSE_STR_TO_CODE[...]`` alias) must exist as a
+   string literal on the host side (``sim/cluster.py`` /
+   ``sim/actions.py``), except the configured jitted-only causes
+   (``engine_failure``: the host raises instead of blocking).
+3. Episode-counter fields: every ``trace["ep_*"]`` key the device
+   collector consumes (``rl/ppo_device.py``) must be traced by
+   ``make_segment_fn``'s per-step dict, and every episode-record key the
+   collector emits must be a key the host's ``harvest_episode_record``
+   (``rl/rollout.py``) knows — device- and host-collected records must
+   stay interchangeable.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set
+
+from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
+
+DEFAULT_PATHS = {
+    "jax_env": "ddls_tpu/sim/jax_env.py",
+    "ppo_device": "ddls_tpu/rl/ppo_device.py",
+    "rollout": "ddls_tpu/rl/rollout.py",
+    "host_cause_files": ["ddls_tpu/sim/cluster.py",
+                         "ddls_tpu/sim/actions.py"],
+}
+DEFAULT_JITTED_ONLY = ["engine_failure"]
+
+
+def _get_sf(ctx: Context, rel: str) -> Optional[SourceFile]:
+    """The shared parsed file; files outside the scanned roots are parsed
+    at most once here and cached into the context."""
+    sf = ctx.get(rel)
+    if sf is not None:
+        return sf
+    path = os.path.join(ctx.repo_root, rel)
+    if not os.path.exists(path):
+        return None
+    sf = SourceFile(path, rel.replace(os.sep, "/"))
+    ctx.files[sf.rel] = sf
+    return sf
+
+
+def _str_constants(tree: ast.AST) -> Set[str]:
+    """String literals in CODE positions — docstrings and bare prose
+    statements are skipped, so a cause word surviving only in a
+    docstring cannot keep the drift check green."""
+    out: Set[str] = set()
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            continue
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _function(tree: ast.Module, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+class BackendSurfaceParityRule(Rule):
+    id = "backend-surface-parity"
+    pointer = ("host and jitted decision surfaces move TOGETHER "
+               "(CLAUDE.md: any semantic change lands in all backends): "
+               "keep CAUSE_CODE_TO_STR bijective over the CAUSE_* "
+               "constants, host cause strings in sim/cluster.py//"
+               "sim/actions.py, and make_segment_fn's ep_* trace keys in "
+               "sync with rl/ppo_device.py + rollout.py's "
+               "harvest_episode_record keys")
+    scope_dirs = ()  # tree-level rule: no per-file pass
+
+    def in_scope(self, rel: str) -> bool:
+        return False
+
+    # ------------------------------------------------------------- helpers
+    def _paths(self, ctx: Context) -> Dict[str, object]:
+        cfg = ctx.config.rule(self.id)
+        paths = dict(DEFAULT_PATHS)
+        paths.update({k: cfg[k] for k in DEFAULT_PATHS if k in cfg})
+        return paths
+
+    def check_tree(self, ctx: Context) -> List[Finding]:
+        paths = self._paths(ctx)
+        jitted_only = set(ctx.config.rule(self.id).get(
+            "jitted_only_causes", DEFAULT_JITTED_ONLY))
+        findings: List[Finding] = []
+
+        jax_env = _get_sf(ctx, str(paths["jax_env"]))
+        ppo_device = _get_sf(ctx, str(paths["ppo_device"]))
+        rollout = _get_sf(ctx, str(paths["rollout"]))
+        host_files = [_get_sf(ctx, str(p))
+                      for p in paths["host_cause_files"]]
+        for rel, sf in ([(paths["jax_env"], jax_env),
+                         (paths["ppo_device"], ppo_device),
+                         (paths["rollout"], rollout)]
+                        + list(zip(paths["host_cause_files"],
+                                   host_files))):
+            if sf is None or sf.tree is None:
+                findings.append(Finding(
+                    self.id, "pyproject.toml", 1,
+                    f"backend-surface-parity cannot read {rel!r} — fix "
+                    "the [tool.ddls_lint.backend-surface-parity] path"))
+        if any(sf is None or sf.tree is None
+               for sf in (jax_env, ppo_device, rollout)):
+            return findings
+
+        if all(sf is not None and sf.tree is not None
+               for sf in host_files):
+            # a missing host file is already a finding above; comparing
+            # against half the host vocabulary would add spurious
+            # drift noise on top
+            findings.extend(self._check_cause_tables(
+                jax_env, list(host_files), jitted_only))
+        findings.extend(self._check_episode_fields(
+            jax_env, ppo_device, rollout))
+        return findings
+
+    # --------------------------------------------------------- cause codes
+    def _check_cause_tables(self, jax_env: SourceFile,
+                            host_files: List[SourceFile],
+                            jitted_only: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        constants: Dict[str, int] = {}
+        table: Dict[str, object] = {}
+        table_line = 1
+        aliases: Dict[str, int] = {}
+        for node in jax_env.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if (isinstance(target, ast.Name)
+                    and target.id.startswith("CAUSE_")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                constants[target.id] = node.value.value
+            elif (isinstance(target, ast.Name)
+                  and target.id == "CAUSE_CODE_TO_STR"
+                  and isinstance(node.value, ast.Dict)):
+                table_line = node.lineno
+                for k, v in zip(node.value.keys, node.value.values):
+                    kname = (k.id if isinstance(k, ast.Name) else
+                             ast.unparse(k))
+                    table[kname] = (v.value if isinstance(v, ast.Constant)
+                                    else ast.unparse(v))
+            elif (isinstance(target, ast.Subscript)
+                  and isinstance(target.value, ast.Name)
+                  and target.value.id == "CAUSE_STR_TO_CODE"
+                  and isinstance(target.slice, ast.Constant)):
+                aliases[str(target.slice.value)] = node.lineno
+
+        if not constants or not table:
+            findings.append(Finding(
+                self.id, jax_env.rel, 1,
+                "could not locate the CAUSE_* constants / "
+                "CAUSE_CODE_TO_STR table — the jitted cause-code surface "
+                "moved; update backend-surface-parity"))
+            return findings
+
+        values = sorted(constants.values())
+        if len(set(values)) != len(values):
+            findings.append(Finding(
+                self.id, jax_env.rel, table_line,
+                f"CAUSE_* constants are not pairwise distinct: "
+                f"{constants}"))
+        missing = sorted(set(constants) - set(table))
+        extra = sorted(set(table) - set(constants))
+        if missing or extra:
+            findings.append(Finding(
+                self.id, jax_env.rel, table_line,
+                f"CAUSE_CODE_TO_STR is not a bijection over the CAUSE_* "
+                f"constants (missing {missing}, unknown {extra})"))
+        strings = [v for v in table.values() if isinstance(v, str)]
+        dupes = sorted({s for s in strings if strings.count(s) > 1})
+        if dupes:
+            findings.append(Finding(
+                self.id, jax_env.rel, table_line,
+                f"CAUSE_CODE_TO_STR string values are not unique "
+                f"(duplicated: {dupes}) — the str->code inverse is "
+                "ambiguous"))
+
+        host_strings: Set[str] = set()
+        for sf in host_files:
+            host_strings |= _str_constants(sf.tree)
+        for cause in sorted((set(strings) | set(aliases)) - jitted_only):
+            if cause not in host_strings:
+                findings.append(Finding(
+                    self.id, jax_env.rel,
+                    aliases.get(cause, table_line),
+                    f"jitted cause string {cause!r} does not exist on "
+                    "the host side (sim/cluster.py / sim/actions.py) — "
+                    "host and jitted cause vocabularies drifted"))
+        return findings
+
+    # ----------------------------------------------------- episode fields
+    def _check_episode_fields(self, jax_env: SourceFile,
+                              ppo_device: SourceFile,
+                              rollout: SourceFile) -> List[Finding]:
+        findings: List[Finding] = []
+        segment_fn = _function(jax_env.tree, "make_segment_fn")
+        if segment_fn is None:
+            return [Finding(
+                self.id, jax_env.rel, 1,
+                "make_segment_fn not found — the segment-trace surface "
+                "moved; update backend-surface-parity")]
+        traced = {k for k in _str_constants(segment_fn)
+                  if k.startswith("ep_")}
+
+        consumed = set()
+        for node in ast.walk(ppo_device.tree):
+            if (isinstance(node, ast.Subscript)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and node.slice.value.startswith("ep_")):
+                consumed.add(node.slice.value)
+        for key in sorted(consumed - traced):
+            findings.append(Finding(
+                self.id, ppo_device.rel, 1,
+                f"device collector consumes trace[{key!r}] but "
+                "make_segment_fn does not trace it — episode-counter "
+                "fields drifted"))
+
+        harvest = _function(rollout.tree, "harvest_episode_record")
+        if harvest is None:
+            return findings + [Finding(
+                self.id, rollout.rel, 1,
+                "harvest_episode_record not found — the host episode-"
+                "record surface moved; update backend-surface-parity")]
+        host_keys = _str_constants(harvest) | {
+            f"mean_{k}" for k in _str_constants(harvest)}
+        device_harvest = _function(ppo_device.tree, "_harvest_episodes")
+        if device_harvest is not None:
+            for node in ast.walk(device_harvest):
+                if not isinstance(node, ast.Dict):
+                    continue
+                for k in node.keys:
+                    if (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            and k.value not in host_keys):
+                        findings.append(Finding(
+                            self.id, ppo_device.rel, k.lineno,
+                            f"device episode record key {k.value!r} is "
+                            "not a host harvest_episode_record key — "
+                            "device/host episode records drifted"))
+        return findings

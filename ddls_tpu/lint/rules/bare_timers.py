@@ -1,0 +1,62 @@
+"""bare-timers: flag ad-hoc ``time.perf_counter`` timing in ``ddls_tpu/``.
+
+Port of ``scripts/check_no_bare_timers.py`` (now a shim over this rule).
+The telemetry layer (docs/telemetry.md) is the one vocabulary for timing
+evidence — ``t0 = time.perf_counter(); ...; dt = time.perf_counter() -
+t0`` pairs produce numbers nothing can aggregate or ship to a sink. The
+audited per-file occurrence allowance (clock *parameters* and control
+decisions, never reporting) lives in ``[tool.ddls_lint.bare-timers.allow]``
+in pyproject.toml, each entry with a why-comment — that review friction
+is the point.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
+
+TOKEN = "perf_counter"
+
+
+class BareTimersRule(Rule):
+    id = "bare-timers"
+    pointer = ("use `with telemetry.span(\"name\"): ...` "
+               "(from ddls_tpu import telemetry; docs/telemetry.md) so "
+               "the timing lands in snapshots, W&B, and JSONL sinks "
+               "instead of a local variable; legitimate clock plumbing "
+               "goes in [tool.ddls_lint.bare-timers.allow] in "
+               "pyproject.toml with a why-comment")
+    scope_dirs = None  # the whole package
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        occ_lines = [i for i, line in enumerate(sf.lines, start=1)
+                     for _ in range(line.count(TOKEN))]
+        if not occ_lines:
+            return []
+        allow = ctx.config.rule(self.id).get("allow", {})
+        allowed = self.int_allowance(allow, sf.rel)
+        # inline-suppressed occurrences are excluded from the count and
+        # reported as their own (suppressed) findings; when the REST
+        # exceed the allowance, EVERY unsuppressed line is flagged — a
+        # count allowance has no line identity, so pointing at a subset
+        # could name an audited occurrence instead of the new one
+        suppressed = self.inline_suppressed_lines(sf)
+        sup = [ln for ln in occ_lines if ln in suppressed]
+        unsup = [ln for ln in occ_lines if ln not in suppressed]
+        findings = [Finding(
+            self.id, sf.rel, ln, "bare perf_counter timing "
+            "(inline-suppressed occurrence)") for ln in sup]
+        if len(unsup) > allowed:
+            findings += [Finding(
+                self.id, sf.rel, ln,
+                f"bare perf_counter timing ({len(unsup)} occurrence(s) "
+                f"in file, allowance {allowed} — remove the new timer "
+                "or re-audit the allowance)") for ln in unsup]
+        return findings
+
+    def check_tree(self, ctx: Context) -> List[Finding]:
+        allow = ctx.config.rule(self.id).get("allow", {})
+        return (self.validate_allow_keys(ctx, allow, want_int=True)
+                + self.validate_count_allowances(
+                    ctx, allow, lambda sf: sf.text.count(TOKEN),
+                    f"'{TOKEN}' occurrence"))

@@ -1,0 +1,91 @@
+"""flight-gated: flight-recorder emits in hot-path sim/env modules must
+be gated behind ``if _flight.enabled():``.
+
+Port of ``scripts/check_flight_gated.py`` (now a shim over this rule).
+The flight recorder (ddls_tpu/telemetry/flight.py) shares telemetry's
+hot-path contract: disabled by default, near-no-op when off. An ungated
+``flight.emit(...)`` pays argument construction (dicts, list copies,
+clock reads) on EVERY simulator step even with the recorder off; calling
+``enable()``/``disable()``/``reset()`` from a hot-path module is flipping
+the switch outside the CLI entry points that own it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Tuple
+
+from ddls_tpu.lint.core import (Context, Finding, Rule, SourceFile,
+                                dotted_name, module_aliases)
+
+EMIT_ATTRS = ("emit", "extend")
+SWITCH_ATTRS = ("enable", "disable", "reset")
+
+
+def iter_guarded_calls(tree: ast.Module) -> Iterator[Tuple[ast.Call, bool]]:
+    """Every Call in the module with whether it sits lexically inside an
+    ``if`` whose condition mentions ``enabled`` POSITIVELY — the gate
+    idiom (covers ``_flight.enabled()``, ``detail_enabled and ...``
+    hoisted locals). An inverted gate (``if not _flight.enabled():``)
+    guards its ELSE branch, not its body — the body runs exactly when
+    the recorder is OFF. Shared by the flight and telemetry gating
+    rules."""
+
+    def walk(node, guarded):
+        if isinstance(node, ast.If):
+            mentions = "enabled" in ast.unparse(node.test)
+            negated = (isinstance(node.test, ast.UnaryOp)
+                       and isinstance(node.test.op, ast.Not))
+            body_guarded = guarded or (mentions and not negated)
+            orelse_guarded = guarded or (mentions and negated)
+            for child in node.body:
+                yield from walk(child, body_guarded)
+            for child in node.orelse:
+                yield from walk(child, orelse_guarded)
+            yield from walk(node.test, guarded)
+            return
+        if isinstance(node, ast.Call):
+            yield node, guarded
+        for child in ast.iter_child_nodes(node):
+            yield from walk(child, guarded)
+
+    yield from walk(tree, False)
+
+
+def _is_alias_call(node: ast.Call, aliases: set, attrs) -> bool:
+    # dotted_name covers both `_flight.emit(...)` (bare alias) and the
+    # unaliased `ddls_tpu.telemetry.flight.emit(...)` access path
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr in attrs
+            and dotted_name(node.func.value) in aliases)
+
+
+class FlightGatedRule(Rule):
+    id = "flight-gated"
+    pointer = ("gate hot-path recorder calls as `if _flight.enabled(): "
+               "_flight.emit(...)` (from ddls_tpu.telemetry import flight "
+               "as _flight; docs/telemetry.md \"Flight recorder\") so a "
+               "disabled recorder costs one bool check and zero event "
+               "objects")
+    scope_dirs = ("ddls_tpu/sim/", "ddls_tpu/envs/")
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        if "flight" not in sf.text or sf.tree is None:
+            return []
+        aliases = module_aliases(sf.tree, "telemetry", "flight")
+        if not aliases:
+            return []
+        findings = []
+        for call, guarded in iter_guarded_calls(sf.tree):
+            if _is_alias_call(call, aliases, SWITCH_ATTRS):
+                findings.append(Finding(
+                    self.id, sf.rel, call.lineno,
+                    f"hot-path module calls flight.{call.func.attr}() — "
+                    "the recorder switch belongs to entry points"))
+            elif (_is_alias_call(call, aliases, EMIT_ATTRS)
+                  and not guarded):
+                findings.append(Finding(
+                    self.id, sf.rel, call.lineno,
+                    f"ungated flight.{call.func.attr}(...) — wrap in "
+                    "`if _flight.enabled():`"))
+        findings.sort(key=lambda f: f.line)
+        return findings

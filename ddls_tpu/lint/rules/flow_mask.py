@@ -1,0 +1,108 @@
+"""flow-mask: never re-inline the flow-ness predicate.
+
+Design invariant (CLAUDE.md, docs/perf_round3.md): a dep is a *flow* iff
+its size is nonzero AND its endpoints sit on different servers — and that
+predicate has exactly one home, ``OpGraph.flow_mask`` /
+``flow_mask_from_codes`` (graphs/op_graph.py), so the host engine, the
+C++ engine, the packers, and the dep placer can never disagree on
+flow-ness. A re-inlined copy drifts silently the day the canonical
+definition changes.
+
+Mechanics: outside the defining module, flag any single boolean
+expression (``and`` / ``&`` chain) that combines a ``<something
+size-ish> > 0`` comparison with a ``!=`` comparison — the predicate's
+structural fingerprint. The one sanctioned re-statement (the traced
+mirror inside the jitted env, which cannot call the numpy helper under
+trace) carries an inline suppression with its reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
+
+DEFINING_MODULE = "ddls_tpu/graphs/op_graph.py"
+
+
+def _bool_chain(node: ast.AST) -> Iterator[ast.AST]:
+    """Flatten an ``and``/``&`` chain into its comparison leaves."""
+    if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.And):
+        for value in node.values:
+            yield from _bool_chain(value)
+    elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitAnd):
+        yield from _bool_chain(node.left)
+        yield from _bool_chain(node.right)
+    else:
+        yield node
+
+
+def _mentions_size(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and "size" in sub.id:
+            return True
+        if isinstance(sub, ast.Attribute) and "size" in sub.attr:
+            return True
+        if (isinstance(sub, ast.Constant) and isinstance(sub.value, str)
+                and "size" in sub.value):
+            return True
+    return False
+
+
+def _is_size_gt_zero(leaf: ast.AST) -> bool:
+    if not (isinstance(leaf, ast.Compare) and len(leaf.ops) == 1):
+        return False
+    op, right = leaf.ops[0], leaf.comparators[0]
+    if (isinstance(op, ast.Gt) and isinstance(right, ast.Constant)
+            and right.value == 0):
+        return _mentions_size(leaf.left)
+    if (isinstance(op, ast.Lt) and isinstance(leaf.left, ast.Constant)
+            and leaf.left.value == 0):
+        return _mentions_size(right)
+    return False
+
+
+def _is_noteq(leaf: ast.AST) -> bool:
+    return (isinstance(leaf, ast.Compare) and len(leaf.ops) == 1
+            and isinstance(leaf.ops[0], ast.NotEq))
+
+
+class FlowMaskRule(Rule):
+    id = "flow-mask"
+    pointer = ("flow-ness has one home: OpGraph.flow_mask / "
+               "flow_mask_from_codes (graphs/op_graph.py) — build the "
+               "per-op server codes and index the returned mask instead "
+               "of re-stating `size > 0 and src_server != dst_server` "
+               "(see cluster.py _register_running_job for the idiom)")
+    scope_dirs = None  # the whole package
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        defining = ctx.config.rule(self.id).get("defining_module",
+                                                DEFINING_MODULE)
+        if sf.rel == defining or sf.tree is None:
+            return []
+        findings = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.BoolOp, ast.BinOp)):
+                continue
+            # only inspect chain ROOTS (a parent BoolOp/BinOp already
+            # covered its nested parts)
+            leaves = list(_bool_chain(node))
+            if len(leaves) < 2:
+                continue
+            if (any(_is_size_gt_zero(l) for l in leaves)
+                    and any(_is_noteq(l) for l in leaves)):
+                findings.append(Finding(
+                    self.id, sf.rel, node.lineno,
+                    "re-inlined flow predicate (`size > 0` AND `!=` in "
+                    "one boolean chain) — route through "
+                    "OpGraph.flow_mask/flow_mask_from_codes"))
+        # a nested BinOp inside a flagged root would double-report the
+        # same expression: dedupe by line
+        seen = set()
+        unique = []
+        for f in sorted(findings, key=lambda f: f.line):
+            if f.line not in seen:
+                seen.add(f.line)
+                unique.append(f)
+        return unique

@@ -1,0 +1,93 @@
+"""frozen-param-tree: model ``setup()`` attribute names are frozen by
+the shipped checkpoints.
+
+Flax param-tree paths are the ``setup()`` attribute names (CLAUDE.md:
+``gnn``/``graph_module``/``logit_head``/``value_head`` for
+``GNNPolicy``); renaming one — or adding a head — silently orphans every
+shipped checkpoint at restore time. Each class in ``ddls_tpu/models/``
+that defines ``setup()`` must have a frozen-name entry in
+``[tool.ddls_lint.frozen-param-tree.classes]`` (``"path::Class" =
+["name", ...]``), and its self-assignments must match that list EXACTLY:
+a new class or a changed name set fails lint until the config entry is
+deliberately updated — which is the checkpoint-compatibility review this
+rule exists to force.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
+
+
+def _setup_assigned_names(setup: ast.FunctionDef) -> Dict[str, int]:
+    """``self.<name> = ...`` targets in a setup() body -> first line."""
+    names: Dict[str, int] = {}
+    for node in ast.walk(setup):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                names.setdefault(t.attr, t.lineno)
+    return names
+
+
+class FrozenParamTreeRule(Rule):
+    id = "frozen-param-tree"
+    pointer = ("setup() attribute names ARE the checkpoint param-tree "
+               "paths — keep them equal to the frozen list in "
+               "[tool.ddls_lint.frozen-param-tree.classes]; changing "
+               "them means every shipped checkpoint must be migrated "
+               "(CLAUDE.md batched_policy_apply invariant)")
+    scope_dirs = ("ddls_tpu/models/",)
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        if sf.tree is None or "def setup" not in sf.text:
+            return []
+        classes = ctx.config.rule(self.id).get("classes", {})
+        findings = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            setup = next((n for n in node.body
+                          if isinstance(n, ast.FunctionDef)
+                          and n.name == "setup"), None)
+            if setup is None:
+                continue
+            key = f"{sf.rel}::{node.name}"
+            frozen = classes.get(key)
+            if frozen is None:
+                findings.append(Finding(
+                    self.id, sf.rel, setup.lineno,
+                    f"{node.name}.setup() has no frozen-param-tree "
+                    f"entry — add '{key}' to [tool.ddls_lint."
+                    "frozen-param-tree.classes] (its attribute names "
+                    "freeze the checkpoint param-tree paths)"))
+                continue
+            assigned = _setup_assigned_names(setup)
+            extra = sorted(set(assigned) - set(frozen))
+            missing = sorted(set(frozen) - set(assigned))
+            if extra or missing:
+                detail = []
+                if extra:
+                    detail.append(f"unexpected {extra}")
+                if missing:
+                    detail.append(f"missing {missing}")
+                findings.append(Finding(
+                    self.id, sf.rel,
+                    min(assigned.values(), default=setup.lineno),
+                    f"{node.name}.setup() attribute names drifted from "
+                    f"the frozen param-tree list: {'; '.join(detail)} "
+                    f"(frozen: {sorted(frozen)})"))
+        findings.sort(key=lambda f: f.line)
+        return findings
+
+    def check_tree(self, ctx: Context) -> List[Finding]:
+        return self.validate_allow_keys(
+            ctx, ctx.config.rule(self.id).get("classes", {}),
+            want_qualname=True, table=".classes", entity="class")

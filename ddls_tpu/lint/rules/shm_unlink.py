@@ -1,0 +1,74 @@
+"""shm-unlink: every ``SharedMemory(create=True)`` must keep its paired
+unlink + crash-path finalizer.
+
+Port of ``scripts/check_shm_unlink.py`` (now a shim over this rule).
+The shm rollout backend (ddls_tpu/rl/shm.py, docs/perf_round7.md) owns
+POSIX shared-memory segments whose names outlive the process if nobody
+unlinks them — an interrupted pytest run or a crashed collector would
+litter ``/dev/shm`` until reboot. Contract: a file that creates segments
+must also carry an ``.unlink()`` call AND a ``weakref.finalize``/
+``atexit`` fallback for paths that never reach ``close()``. Deliberate
+tracker-owned exceptions go in ``[tool.ddls_lint.shm-unlink.allow]``
+with a why-comment.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
+
+_CREATE_RE = re.compile(r"SharedMemory\s*\([^)]*create\s*=\s*True",
+                        re.DOTALL)
+
+
+class ShmUnlinkRule(Rule):
+    id = "shm-unlink"
+    pointer = ("pair every SharedMemory(create=True) with an .unlink() on "
+               "close AND a weakref.finalize/atexit fallback (see "
+               "ddls_tpu/rl/shm.py SlabSet), or the segment outlives a "
+               "crashed run in /dev/shm; deliberately tracker-owned "
+               "segments go in [tool.ddls_lint.shm-unlink.allow] in "
+               "pyproject.toml with a why-comment")
+    scope_dirs = None  # the whole package
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        matches = list(_CREATE_RE.finditer(sf.text))
+        if not matches:
+            return []
+        missing = []
+        if ".unlink(" not in sf.text:
+            missing.append("unlink")
+        if ("weakref.finalize" not in sf.text
+                and "atexit" not in sf.text):
+            missing.append("finalizer (weakref.finalize/atexit)")
+        if not missing:
+            return []
+        allow = ctx.config.rule(self.id).get("allow", {})
+        allowed = self.int_allowance(allow, sf.rel)
+        # same attribution contract as bare-timers: suppressed creates
+        # are excluded (and reported as their own suppressed findings);
+        # when the rest exceed the allowance, every unsuppressed create
+        # line is flagged — the allowance has no line identity
+        lines = [sf.text.count("\n", 0, m.start()) + 1 for m in matches]
+        suppressed = self.inline_suppressed_lines(sf)
+        sup = [ln for ln in lines if ln in suppressed]
+        unsup = [ln for ln in lines if ln not in suppressed]
+        findings = [Finding(
+            self.id, sf.rel, ln, "SharedMemory create "
+            "(inline-suppressed)") for ln in sup]
+        if len(unsup) > allowed:
+            findings += [Finding(
+                self.id, sf.rel, ln,
+                f"SharedMemory create without leak-proof pairing "
+                f"({len(unsup)} create(s) in file, allowance {allowed}), "
+                f"missing {' + '.join(missing)}") for ln in unsup]
+        return findings
+
+    def check_tree(self, ctx: Context) -> List[Finding]:
+        allow = ctx.config.rule(self.id).get("allow", {})
+        return (self.validate_allow_keys(ctx, allow, want_int=True)
+                + self.validate_count_allowances(
+                    ctx, allow,
+                    lambda sf: len(_CREATE_RE.findall(sf.text)),
+                    "SharedMemory create"))

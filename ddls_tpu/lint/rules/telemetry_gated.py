@@ -1,0 +1,79 @@
+"""telemetry-gated: hot-path telemetry calls must allocate nothing when
+telemetry is off.
+
+Generalises the flight-recorder gating check (CLAUDE.md telemetry
+invariant) to the module-level telemetry API in ``sim/`` and ``envs/``:
+``telemetry.inc/observe/set_gauge/record_event/span`` are one-bool
+no-ops while disabled, but their ARGUMENTS are evaluated at the call
+site — an f-string metric name, a ``sum(...)`` payload, or a dict built
+inline pays allocation on every simulator step with telemetry off. Calls
+whose arguments are trivial (constants, bare names, attribute reads)
+stay legal ungated; anything that computes must sit inside the
+``if telemetry.enabled():`` idiom. Flipping the global switch
+(``enable``/``disable``/``reset``) from a hot-path module is always
+flagged — that belongs to CLI entry points and tests.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ddls_tpu.lint.core import (Context, Finding, Rule, SourceFile,
+                                dotted_name, module_aliases)
+from ddls_tpu.lint.rules.flight_gated import iter_guarded_calls
+
+GATED_ATTRS = ("inc", "observe", "set_gauge", "record_event", "span")
+SWITCH_ATTRS = ("enable", "disable", "reset")
+
+
+def _is_trivial(node: ast.AST) -> bool:
+    """No allocation / computation at call time: constants, bare names,
+    attribute reads, and unary/conditional combinations thereof."""
+    if isinstance(node, (ast.Constant, ast.Name, ast.Attribute)):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _is_trivial(node.operand)
+    if isinstance(node, ast.IfExp):
+        return (_is_trivial(node.test) and _is_trivial(node.body)
+                and _is_trivial(node.orelse))
+    return False
+
+
+class TelemetryGatedRule(Rule):
+    id = "telemetry-gated"
+    pointer = ("gate allocating telemetry calls as `if telemetry."
+               "enabled(): telemetry.inc(...)` (docs/telemetry.md hot-"
+               "path contract: one bool check, zero allocations when "
+               "off); constant-argument calls may stay ungated")
+    scope_dirs = ("ddls_tpu/sim/", "ddls_tpu/envs/")
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        if "telemetry" not in sf.text or sf.tree is None:
+            return []
+        aliases = module_aliases(sf.tree, "ddls_tpu", "telemetry")
+        if not aliases:
+            return []
+        findings = []
+        for call, guarded in iter_guarded_calls(sf.tree):
+            func = call.func
+            # dotted_name covers both the bare alias (`telemetry.inc`)
+            # and the unaliased `ddls_tpu.telemetry.inc` access path
+            if not (isinstance(func, ast.Attribute)
+                    and dotted_name(func.value) in aliases):
+                continue
+            if func.attr in SWITCH_ATTRS:
+                findings.append(Finding(
+                    self.id, sf.rel, call.lineno,
+                    f"hot-path module calls telemetry.{func.attr}() — "
+                    "the global switch belongs to entry points"))
+            elif func.attr in GATED_ATTRS and not guarded:
+                args = list(call.args) + [kw.value for kw in call.keywords]
+                if all(_is_trivial(a) for a in args):
+                    continue
+                findings.append(Finding(
+                    self.id, sf.rel, call.lineno,
+                    f"ungated telemetry.{func.attr}(...) with computed "
+                    "arguments — wrap in `if telemetry.enabled():` (the "
+                    "args are evaluated even while telemetry is off)"))
+        findings.sort(key=lambda f: f.line)
+        return findings

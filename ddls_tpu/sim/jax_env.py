@@ -633,7 +633,7 @@ def jax_price_and_score(sc, cfg, tables, st: ShapeTables,
     # under trace, so this is the one sanctioned re-statement; its parity
     # with the native path is pinned by tests/test_jax_pricing.py's
     # is_flow comparison
-    is_flow = dep_valid & (dep_size > 0) & (sc_src != sc_dst)
+    is_flow = dep_valid & (dep_size > 0) & (sc_src != sc_dst)  # ddls-lint: allow(flow-mask) -- the one sanctioned traced mirror of flow_mask_from_codes: the numpy helper cannot run under jit trace; parity pinned by test_jax_pricing.py
 
     dt = dep_size.dtype
     times = jnp.zeros((M + 1,), dt)

@@ -138,16 +138,18 @@ def build_lookahead_arrays(cluster, job, pad_ops: int, pad_deps: int,
         edge_pri = dict(zip(payload.edge_ids, pri_l))
     else:
         edge_chan = edge_pri = None
+    # flow-ness comes from THE canonical predicate (OpGraph.flow_mask);
+    # the mask is aligned with finalize()'s edge order, which is exactly
+    # what arrays["edge_index"] indexes
+    _, edge_flow = graph.flow_mask(
+        [worker_to_server[op_to_worker[op]] for op in graph.op_ids])
     for edge in graph.edge_ids:
         ei = arrays["edge_index"][edge]
         u, v = edge
         dep_src[ei] = arrays["op_index"][u]
         dep_dst[ei] = arrays["op_index"][v]
         dep_remaining[ei] = job.dep_init_run_time.get(edge, 0.0)
-        src_w = op_to_worker[u]
-        dst_w = op_to_worker[v]
-        is_flow = (graph.edge_size(u, v) > 0
-                   and worker_to_server[src_w] != worker_to_server[dst_w])
+        is_flow = bool(edge_flow[ei])
         dep_is_flow[ei] = is_flow
         if is_flow:
             if edge_chan is not None:

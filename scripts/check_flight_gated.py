@@ -1,151 +1,29 @@
 """Hygiene check: flight-recorder emits in hot-path sim/env modules must
 be gated.
 
-The flight recorder (ddls_tpu/telemetry/flight.py) shares telemetry's
-hot-path contract (CLAUDE.md): disabled by default, near-no-op when off.
-An ungated ``flight.emit(...)`` in the simulator or an environment pays
-argument construction (dicts, list copies, clock reads) on EVERY step
-even with the recorder off. This script parses every module under
-``ddls_tpu/sim/`` and ``ddls_tpu/envs/`` and fails when
-
-* a ``<flight alias>.emit(...)`` / ``.extend(...)`` call is not
-  lexically inside an ``if`` whose condition mentions ``enabled`` (the
-  ``if _flight.enabled():`` / ``if detail_enabled and ...:`` idiom), or
-* a hot-path module calls ``enable()`` / ``disable()`` / ``reset()`` on
-  the recorder at all — flipping the switch belongs to CLI entry points
-  and tests, never to the simulator.
+Thin shim over the lint engine's ``flight-gated`` rule
+(ddls_tpu/lint/rules/flight_gated.py) — same CLI flags and return codes
+as the original standalone checker, so tier-1 tests
+(tests/test_flight.py) and docs references keep working unchanged.
 
 Run: ``python scripts/check_flight_gated.py`` (rc 0 clean, 1 flagged).
-CI/tests run it over the real tree (tests/test_flight.py, tier-1 — the
-sibling of scripts/check_no_bare_timers.py); ``--paths`` scans alternate
-roots (the self-test uses a synthetic tree).
+``--paths`` scans alternate roots (the self-test uses a synthetic tree).
+Prefer ``python scripts/lint.py`` for the full rule set.
 """
 from __future__ import annotations
 
-import argparse
-import ast
 import os
 import sys
 
-SCAN_DIRS = (os.path.join("ddls_tpu", "sim"),
-             os.path.join("ddls_tpu", "envs"))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-EMIT_ATTRS = ("emit", "extend")
-SWITCH_ATTRS = ("enable", "disable", "reset")
-
-POINTER = ("gate hot-path recorder calls as `if _flight.enabled(): "
-           "_flight.emit(...)` (from ddls_tpu.telemetry import flight "
-           "as _flight; docs/telemetry.md \"Flight recorder\") so a "
-           "disabled recorder costs one bool check and zero event "
-           "objects")
-
-
-def _flight_aliases(tree: ast.Module) -> set:
-    """Names this module binds to the flight module."""
-    aliases = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            if node.module and node.module.endswith("telemetry"):
-                for a in node.names:
-                    if a.name == "flight":
-                        aliases.add(a.asname or a.name)
-        elif isinstance(node, ast.Import):
-            for a in node.names:
-                if a.name.endswith("telemetry.flight"):
-                    aliases.add(a.asname or a.name.split(".")[0])
-    return aliases
-
-
-def _violations_in(tree: ast.Module, aliases: set) -> list:
-    """(lineno, message) for every ungated emit / forbidden switch call."""
-    out = []
-
-    def is_flight_call(node, attrs):
-        return (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in attrs
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id in aliases)
-
-    def walk(node, guarded):
-        if isinstance(node, ast.If):
-            # the guard idiom: any enclosing `if` whose condition
-            # mentions `enabled` (covers `_flight.enabled()`,
-            # `_flight.detail_enabled()`, and hoisted `detail_enabled`
-            # locals)
-            body_guarded = guarded or ("enabled" in ast.unparse(node.test))
-            for child in node.body:
-                walk(child, body_guarded)
-            for child in node.orelse:
-                walk(child, guarded)
-            walk(node.test, guarded)
-            return
-        if is_flight_call(node, SWITCH_ATTRS):
-            out.append((node.lineno,
-                        f"hot-path module calls flight.{node.func.attr}() "
-                        "— the recorder switch belongs to entry points"))
-        elif is_flight_call(node, EMIT_ATTRS) and not guarded:
-            out.append((node.lineno,
-                        f"ungated flight.{node.func.attr}(...) — wrap in "
-                        "`if _flight.enabled():`"))
-        for child in ast.iter_child_nodes(node):
-            walk(child, guarded)
-
-    walk(tree, False)
-    return sorted(out)
-
-
-def scan(roots, rel_to: str) -> list:
-    """(relpath, lineno, message) violations over every .py file."""
-    violations = []
-    for root in roots:
-        for dirpath, dirnames, filenames in os.walk(root):
-            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-            for fn in sorted(filenames):
-                if not fn.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, fn)
-                with open(path, encoding="utf-8", errors="replace") as f:
-                    src = f.read()
-                if "flight" not in src:
-                    continue
-                try:
-                    tree = ast.parse(src)
-                except SyntaxError as e:
-                    violations.append((os.path.relpath(path, rel_to),
-                                       e.lineno or 0,
-                                       f"unparseable: {e.msg}"))
-                    continue
-                aliases = _flight_aliases(tree)
-                if not aliases:
-                    continue
-                for lineno, msg in _violations_in(tree, aliases):
-                    violations.append((os.path.relpath(path, rel_to),
-                                       lineno, msg))
-    return violations
-
-
-def main(argv=None) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    parser = argparse.ArgumentParser(
-        description="flag ungated flight-recorder calls in hot-path "
-                    "sim/env modules")
-    parser.add_argument("--paths", nargs="*", default=None,
-                        help="roots to scan (default: ddls_tpu/sim and "
-                             "ddls_tpu/envs in the repo)")
-    args = parser.parse_args(argv)
-    roots = args.paths or [os.path.join(repo, d) for d in SCAN_DIRS]
-
-    violations = scan(roots, repo)
-    if violations:
-        print("ungated flight-recorder usage in hot-path modules:")
-        for rel, lineno, msg in violations:
-            print(f"  {rel}:{lineno}: {msg}")
-        print(f"fix: {POINTER}")
-        return 1
-    print("ok: every hot-path flight-recorder call is gated")
-    return 0
+from ddls_tpu.lint.engine import main  # noqa: E402
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(rule_ids=["flight-gated"],
+                  description="flag ungated flight-recorder calls in "
+                              "hot-path sim/env modules",
+                  repo_root=REPO))

@@ -1,93 +1,30 @@
 """Hygiene check: flag bare ``time.perf_counter`` timing in ``ddls_tpu/``.
 
-The telemetry layer (ddls_tpu/telemetry, docs/telemetry.md) is the one
-vocabulary for timing evidence — ad-hoc ``t0 = time.perf_counter(); ...;
-dt = time.perf_counter() - t0`` pairs in hot-path modules produce numbers
-nothing can aggregate, compare across modes, or ship to a sink. This
-script greps the package for ``perf_counter`` and fails when a file
-exceeds its audited allowance, pointing the author at the span API.
+Thin shim over the lint engine's ``bare-timers`` rule
+(ddls_tpu/lint/rules/bare_timers.py) — same CLI flags and return codes
+as the original standalone checker, so tier-1 tests and docs references
+keep working unchanged. The audited per-file ALLOWANCE now lives in
+``[tool.ddls_lint.bare-timers.allow]`` in pyproject.toml (one
+consolidated allowlist home; each entry keeps its why-comment there).
 
 Run: ``python scripts/check_no_bare_timers.py`` (rc 0 clean, 1 flagged).
-CI/tests run it over the real tree; ``--paths`` scans alternate roots
-(the self-test uses a synthetic tree).
-
-To legitimately raise an allowance (a clock *parameter* or a control
-decision, not a measurement destined for a report), update ``ALLOWANCE``
-with a comment saying why — that review friction is the point.
+``--paths`` scans alternate roots (the self-test uses a synthetic tree).
+Prefer ``python scripts/lint.py`` for the full rule set.
 """
 from __future__ import annotations
 
-import argparse
 import os
 import sys
 
-# audited occurrences of the token "perf_counter" per file (relative to
-# the repo root). Each entry is deliberate plumbing, NOT reporting:
-ALLOWANCE = {
-    # the Registry's injectable default clock — the span API itself
-    "ddls_tpu/telemetry/metrics.py": 1,
-    # docstring mention + PolicyServer's injectable default clock
-    "ddls_tpu/serve/server.py": 2,
-    # Router's and build_fleet's injectable default clocks (shared with
-    # every replica — same discipline as PolicyServer's)
-    "ddls_tpu/serve/fleet.py": 2,
-    # RolloutCollector's one-shot adaptive pipeline decision (control
-    # flow that must work with telemetry disabled, never reported)
-    "ddls_tpu/rl/rollout.py": 4,
-}
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-POINTER = ("use `with telemetry.span(\"name\"): ...` "
-           "(from ddls_tpu import telemetry; docs/telemetry.md) so the "
-           "timing lands in snapshots, W&B, and JSONL sinks instead of "
-           "a local variable")
-
-
-def scan(root: str, rel_to: str) -> list:
-    """(relpath, count) for every .py file containing 'perf_counter'."""
-    hits = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8", errors="replace") as f:
-                count = f.read().count("perf_counter")
-            if count:
-                hits.append((os.path.relpath(path, rel_to), count))
-    return hits
-
-
-def main(argv=None) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    parser = argparse.ArgumentParser(
-        description="flag bare time.perf_counter timing in hot-path "
-                    "modules")
-    parser.add_argument("--paths", nargs="*", default=None,
-                        help="roots to scan (default: ddls_tpu/ in the "
-                             "repo; allowances are keyed relative to the "
-                             "repo root)")
-    args = parser.parse_args(argv)
-    roots = args.paths or [os.path.join(repo, "ddls_tpu")]
-
-    violations = []
-    for root in roots:
-        for rel, count in scan(root, repo):
-            allowed = ALLOWANCE.get(rel.replace(os.sep, "/"), 0)
-            if count > allowed:
-                violations.append((rel, count, allowed))
-
-    if violations:
-        print("bare perf_counter timing found in hot-path modules:")
-        for rel, count, allowed in sorted(violations):
-            print(f"  {rel}: {count} occurrence(s), allowance {allowed}")
-        print(f"fix: {POINTER}")
-        print("(legitimate clock plumbing? raise ALLOWANCE in "
-              "scripts/check_no_bare_timers.py with a why-comment)")
-        return 1
-    print("ok: no bare perf_counter timing beyond the audited allowance")
-    return 0
+from ddls_tpu.lint.engine import main  # noqa: E402
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(rule_ids=["bare-timers"],
+                  description="flag bare time.perf_counter timing in "
+                              "hot-path modules",
+                  repo_root=REPO))

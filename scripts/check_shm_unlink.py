@@ -1,105 +1,31 @@
 """Hygiene check: every shared-memory CREATE in ``ddls_tpu/`` must keep
 its paired unlink + crash-path finalizer.
 
-The shm rollout backend (ddls_tpu/rl/shm.py, docs/perf_round7.md) owns
-POSIX shared-memory segments whose names outlive the process if nobody
-unlinks them — an interrupted pytest run or a crashed collector would
-litter ``/dev/shm`` until reboot. The backend's contract is
-parent-owned lifecycle: ``SharedMemory(create=True)`` only ever appears
-next to an ``unlink()`` call AND a ``weakref.finalize``/``atexit``
-fallback for paths that never reach ``close()``. This script greps the
-package for creates and fails when a file holds one without both
-halves of that pairing, in the same spirit as
-``check_no_bare_timers.py``.
+Thin shim over the lint engine's ``shm-unlink`` rule
+(ddls_tpu/lint/rules/shm_unlink.py) — same CLI flags and return codes
+as the original standalone checker, so tier-1 tests (tests/test_shm.py)
+and docs references keep working unchanged. Deliberate tracker-owned
+exceptions go in ``[tool.ddls_lint.shm-unlink.allow]`` in
+pyproject.toml with a why-comment.
 
 Run: ``python scripts/check_shm_unlink.py`` (rc 0 clean, 1 flagged).
-CI/tests run it over the real tree; ``--paths`` scans alternate roots
-(the self-test uses a synthetic tree).
-
-A legitimate exception (a deliberately tracker-owned scratch segment)
-goes in ``ALLOWANCE`` with a comment saying why — that review friction
-is the point.
+``--paths`` scans alternate roots (the self-test uses a synthetic tree).
+Prefer ``python scripts/lint.py`` for the full rule set.
 """
 from __future__ import annotations
 
-import argparse
 import os
-import re
 import sys
 
-# files allowed to create segments WITHOUT the unlink+finalizer pairing
-# (relative to the repo root). Empty on purpose: every current create
-# lives in rl/shm.py, which carries both.
-ALLOWANCE: dict = {}
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
-_CREATE_RE = re.compile(r"SharedMemory\s*\([^)]*create\s*=\s*True",
-                        re.DOTALL)
-
-POINTER = ("pair every SharedMemory(create=True) with an .unlink() on "
-           "close AND a weakref.finalize/atexit fallback (see "
-           "ddls_tpu/rl/shm.py SlabSet), or the segment outlives a "
-           "crashed run in /dev/shm")
-
-
-def scan(root: str, rel_to: str) -> list:
-    """(relpath, n_creates, has_unlink, has_finalizer) per .py file that
-    creates shared-memory segments."""
-    hits = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8", errors="replace") as f:
-                text = f.read()
-            creates = len(_CREATE_RE.findall(text))
-            if creates:
-                hits.append((os.path.relpath(path, rel_to), creates,
-                             ".unlink(" in text,
-                             ("weakref.finalize" in text
-                              or "atexit" in text)))
-    return hits
-
-
-def main(argv=None) -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    parser = argparse.ArgumentParser(
-        description="flag shared-memory creates without a paired "
-                    "unlink/finalizer")
-    parser.add_argument("--paths", nargs="*", default=None,
-                        help="roots to scan (default: ddls_tpu/ in the "
-                             "repo; allowances are keyed relative to the "
-                             "repo root)")
-    args = parser.parse_args(argv)
-    roots = args.paths or [os.path.join(repo, "ddls_tpu")]
-
-    violations = []
-    for root in roots:
-        for rel, creates, has_unlink, has_finalizer in scan(root, repo):
-            if ALLOWANCE.get(rel.replace(os.sep, "/"), 0) >= creates:
-                continue
-            missing = []
-            if not has_unlink:
-                missing.append("unlink")
-            if not has_finalizer:
-                missing.append("finalizer (weakref.finalize/atexit)")
-            if missing:
-                violations.append((rel, creates, missing))
-
-    if violations:
-        print("shared-memory creates without leak-proof pairing:")
-        for rel, creates, missing in sorted(violations):
-            print(f"  {rel}: {creates} create(s), missing "
-                  f"{' + '.join(missing)}")
-        print(f"fix: {POINTER}")
-        print("(deliberately tracker-owned segment? add an ALLOWANCE in "
-              "scripts/check_shm_unlink.py with a why-comment)")
-        return 1
-    print("ok: every SharedMemory(create=True) keeps its unlink + "
-          "finalizer pairing")
-    return 0
+from ddls_tpu.lint.engine import main  # noqa: E402
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(rule_ids=["shm-unlink"],
+                  description="flag shared-memory creates without a "
+                              "paired unlink/finalizer",
+                  repo_root=REPO))
