@@ -27,12 +27,16 @@ from typing import List
 from ddls_tpu.lint.core import (Context, Finding, Rule, SourceFile,
                                 dotted_name)
 
-#: the collect->update path: the epoch loops and the rollout collectors
+#: the collect->update path: the epoch loops, the rollout collectors,
+#: and the fused epoch driver (whose in-program epoch makes an implicit
+#: coercion doubly expensive: it would re-serialise the ONE dispatch per
+#: epoch the fusion exists to amortise)
 DEFAULT_MODULES = (
     "ddls_tpu/train/loops.py",
     "ddls_tpu/rl/rollout.py",
     "ddls_tpu/rl/ppo_device.py",
     "ddls_tpu/rl/shm.py",
+    "ddls_tpu/rl/fused.py",
 )
 
 _IMPLICIT_COERCIONS = {"np.asarray", "numpy.asarray"}

@@ -29,6 +29,11 @@ from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
 DEFAULT_GUARDED_CALLS = (
     "train_step", "update", "process_allgather", "materialize_group",
     "psum", "pmean", "all_gather", "all_reduce", "broadcast_one_to_all",
+    # the fused epoch IS the sharded update (rl/fused.py): a gate that
+    # desyncs which process dispatches it is the same hang as a desynced
+    # train_step — and the autotuner's fallback gate must stay a pure
+    # function of the cached config, never of probe wall-time or env
+    "fused_epoch",
 )
 
 #: generic method names that only count as guarded calls when the
@@ -83,7 +88,9 @@ class MultihostGatesRule(Rule):
                "shared-stream jax.random draws only (CLAUDE.md "
                "multi-host rules) — never wall clock, `random`, "
                "os.environ, or filesystem state")
-    scope_dirs = ("ddls_tpu/train/",)
+    # train/ loops plus the fused epoch driver: its fused_epoch dispatch
+    # and autotuner fallback are collective-shaped decisions too
+    scope_dirs = ("ddls_tpu/train/", "ddls_tpu/rl/fused.py")
 
     def _guarded_calls(self, ctx: Context) -> Tuple[str, ...]:
         extra = tuple(ctx.config.rule(self.id).get("guarded_calls", ()))

@@ -1,0 +1,756 @@
+"""Fused on-device collect→update training (the Podracer/Anakin shape).
+
+ONE jitted program runs a whole epoch: a `lax.scan` over
+``updates_per_epoch`` collect→update rounds. Each round collects a
+[T, B] segment with the in-kernel environment (`sim/jax_env.py
+make_segment_fn`, vmapped over B job-bank lanes sharded on the mesh's
+``dp`` axis) and applies the learner's scan-based update in-scan — the
+gradient all-reduce over dp is emitted by XLA from the very sharding
+annotations the standalone update uses. Params/opt-state/rng keys are
+carried on device for the entire epoch, so the only host↔device traffic
+per epoch is the ONE dispatch of the fused call: the ~116 ms tunnelled
+axon round-trip (docs/perf_round4.md) is paid once per
+``updates_per_epoch`` updates instead of twice per update
+(PAPERS.md: arXiv 2104.06272 Podracer/Anakin; the pattern JAX-native
+env suites are built for, Jumanji arXiv 2306.09884).
+
+Parity contract: the fused program is the SAME math as the sequential
+device-collector path (`rl/ppo_device.py:DevicePPOCollector` +
+`PPOLearner.train_step`) — same segment kernel, same obs rebuild
+(`_kernel_obs`), same f64-then-f32 cast order on the traj leaves, same
+rng-split bookkeeping as `RLEpochLoop._split_rng`/`_split_collect_rng`
+— pinned exactly in x64 by tests/test_fused.py's full-epoch parity
+driver. Metrics and episode counters come back as DEVICE arrays
+([U]-stacked metric dicts, compact [U, B, T] episode-counter traces)
+and ride the existing LazyMetrics futures contract: the training loop
+drains them per ``metrics_sync_interval`` epochs, never per update
+(hot-path-transfer rule; the steady-state epoch passes
+``jax.transfer_guard("disallow")``).
+
+Autotuner: the axon ``remote_compile`` endpoint rejects large programs
+(docs/perf_round4.md — wide-vmap episode kernels fail; few lanes x long
+segments wins, and is also the documented perf preference on the
+tunnel). ``autotune_fused`` therefore enumerates (lanes, segment_len)
+factorisations of the requested per-update batch, ranks them by an
+estimated program size (monotonic in lanes, flat in segment_len — a
+scan's program does not grow with its length), probe-compiles them
+smallest-first with a bounded timeout, caches the first config that
+compiles keyed by workload signature + device kind
+(``.probe/fused_autotune.json``), and reports failure so the caller can
+fall back to ``loop_mode="pipelined"`` loudly. A successful probe warms
+the very executable training reuses (jax caches per (jit, shapes)), so
+probing costs nothing extra on the chosen config.
+
+Chip ownership: fused runs own the TPU for their whole duration — hold
+``.probe/tpu.lock`` via ``chip_lock`` so the probe loop never opens a
+second axon client against the owned chip (the documented wedge
+trigger), with ``DDLS_TPU_LOCK_OWNER=1`` exported so the run's OWN
+probes are not mistaken for a second client and diverted to CPU
+(bench.py ``consult_probe_state``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: the tpu.lock owner handshake shared with bench.py's probe cache
+LOCK_OWNER_ENV = "DDLS_TPU_LOCK_OWNER"
+LOCK_FILE = "tpu.lock"
+AUTOTUNE_CACHE_FILE = "fused_autotune.json"
+
+# -------------------------------------------------------------------------
+# Program-size model (ranking only — see estimate_program_bytes).
+# -------------------------------------------------------------------------
+#: serialized-HLO bytes per element of captured config-table constants
+#: (tables are embedded in the program as literals)
+_TABLE_BYTES_PER_CELL = 10.0
+#: marginal serialized bytes per vmapped env lane: GSPMD/batching
+#: materialises per-lane buffer shapes and layouts in the module proto
+#: (round 4's observed failure mode: WIDE vmap episode kernels rejected
+#: by remote_compile while narrow ones compiled)
+_BYTES_PER_LANE = 24_000.0
+#: fixed overhead of the epoch skeleton (scan plumbing, the scanned SGD
+#: update, optimiser state threading)
+_BASE_BYTES = 600_000.0
+
+
+def default_probe_dir() -> str:
+    """The ``.probe`` scratch dir the bench/probe tooling shares
+    (CLAUDE.md TPU practicalities). Overridable via
+    ``DDLS_TPU_PROBE_DIR`` for tests and relocated checkouts."""
+    env = os.environ.get("DDLS_TPU_PROBE_DIR")
+    if env:
+        return env
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo_root, ".probe")
+
+
+# re-exported for fused-path callers; the implementation lives in the
+# jax-free utils module so bench.py's probe consult (which must decide
+# CPU fallback BEFORE any jax import) can use it without dragging the
+# rl package's jax/flax imports in
+from ddls_tpu.utils.common import lock_is_stale  # noqa: F401
+
+
+class chip_lock:
+    """Hold ``.probe/tpu.lock`` for the duration of a fused run.
+
+    The documented convention (CLAUDE.md, docs/perf_round4.md): while a
+    bench or training owns the chip, the lock keeps the probe loop from
+    opening a second axon client — the wedge trigger. While held,
+    ``DDLS_TPU_LOCK_OWNER=1`` is exported so the owner's OWN probes
+    (bench.py ``consult_probe_state``) still run against the TPU instead
+    of silently diverting to CPU.
+
+    If the lock is already held by ANOTHER (live) owner, entry does not
+    block or steal: ``acquired`` stays False, the env var is left alone
+    (our probes then correctly treat the chip as foreign-owned), and
+    exit never removes a lock we do not hold. A lock whose recorded
+    owner pid is provably DEAD is stale — a hard-killed run cannot
+    unlink its own file — and is reclaimed; an ``atexit`` hook
+    additionally releases on interpreter exits that skip ``__exit__``.
+    """
+
+    def __init__(self, probe_dir: Optional[str] = None):
+        self.probe_dir = probe_dir or default_probe_dir()
+        self.path = os.path.join(self.probe_dir, LOCK_FILE)
+        self.acquired = False
+        self.delegated = False
+        self._prev_owner_env: Optional[str] = None
+
+    @property
+    def owned(self) -> bool:
+        """This process tree may use the chip: we hold the lock file
+        ourselves (``acquired``) or a wrapper above us holds it and
+        exported ``DDLS_TPU_LOCK_OWNER`` (``delegated``)."""
+        return self.acquired or self.delegated
+
+    def _try_acquire(self) -> bool:
+        try:
+            os.makedirs(self.probe_dir, exist_ok=True)
+            fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except OSError:
+            return False
+        with os.fdopen(fd, "w") as f:
+            f.write(f"{os.getpid()}\n")
+        return True
+
+    def _reclaim_stale(self) -> bool:
+        """Crash fallback, raced safely: reclaim a dead-owner lock only
+        under an O_EXCL ``.reclaim`` sentinel, so two concurrent
+        reclaimers can never both unlink-then-acquire (that TOCTOU
+        would hand BOTH the chip and wedge the tunnel); the loser
+        defers. A sentinel whose own writer died is itself stale and
+        removed by the same pid-liveness rule."""
+        guard = self.path + ".reclaim"
+        try:
+            fd = os.open(guard, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except OSError:
+            if lock_is_stale(guard):
+                try:
+                    os.unlink(guard)
+                except OSError:
+                    pass
+            return False  # another reclaimer mid-flight: defer
+        try:
+            os.write(fd, f"{os.getpid()}\n".encode())
+            if not lock_is_stale(self.path):  # re-check under the guard
+                return False
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            return self._try_acquire()
+        finally:
+            os.close(fd)
+            try:
+                os.unlink(guard)
+            except OSError:
+                pass
+
+    def __enter__(self) -> "chip_lock":
+        if os.environ.get(LOCK_OWNER_ENV):
+            # a wrapper above this process already owns the chip FOR us
+            # (the documented convention: it holds the lock file and
+            # exports the env var — bench.py consult_probe_state honors
+            # the same handshake): delegated ownership, no file ops,
+            # and exit leaves the wrapper's lock alone
+            self.delegated = True
+            return self
+        got = self._try_acquire()
+        if not got and lock_is_stale(self.path):
+            got = self._reclaim_stale()
+        if not got:
+            return self  # live foreign owner (or unwritable dir)
+        self.acquired = True
+        self._prev_owner_env = os.environ.get(LOCK_OWNER_ENV)
+        os.environ[LOCK_OWNER_ENV] = "1"
+        import atexit
+
+        atexit.register(self.__exit__)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if not self.acquired:
+            return
+        import atexit
+
+        atexit.unregister(self.__exit__)
+        if self._prev_owner_env is None:
+            os.environ.pop(LOCK_OWNER_ENV, None)
+        else:
+            os.environ[LOCK_OWNER_ENV] = self._prev_owner_env
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        self.acquired = False
+
+
+# -------------------------------------------------------------------------
+# Autotuner: candidate enumeration, size model, probe-compile, cache.
+# -------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AutotuneResult:
+    """The chosen fused (lanes, segment_len) config and how it was
+    reached; ``probed`` records every candidate tried as
+    (lanes, segment_len, ok, error)."""
+    lanes: int
+    segment_len: int
+    estimated_bytes: int
+    actual_bytes: Optional[int]
+    source: str                      # "cache" | "probe" | "explicit"
+    probed: List[Tuple[int, int, bool, Optional[str]]] = \
+        dataclasses.field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        return {"lanes": self.lanes, "segment_len": self.segment_len,
+                "estimated_program_bytes": self.estimated_bytes,
+                "actual_program_bytes": self.actual_bytes,
+                "source": self.source,
+                "probed": [{"lanes": l, "segment_len": s, "ok": ok,
+                            "error": err}
+                           for l, s, ok, err in self.probed]}
+
+
+def table_cells(et) -> int:
+    """Total elements across the episode tables' captured constants —
+    the dominant static contribution to fused-program size (reads
+    ``.size`` attributes only; never fetches the device arrays)."""
+    return int(sum(int(np.prod(getattr(v, "shape", ()) or (1,)))
+                   for v in et.tables.values()))
+
+
+def estimate_program_bytes(lanes: int, segment_len: int,
+                           n_table_cells: int) -> int:
+    """Estimated serialized-program size of the fused epoch.
+
+    A RANKING model, not a measurement: calibrated coarsely against the
+    round-4 observation that program size (and the axon remote_compile
+    failure mode) grows with vmap WIDTH while `lax.scan` keeps it flat
+    in segment length and update count. Monotonic in ``lanes``, constant
+    in ``segment_len`` — exactly the "few lanes x long segments"
+    preference docs/perf_round4.md measured. Probe compilation supplies
+    the actual size (``AutotuneResult.actual_bytes``) for the artifact.
+    """
+    del segment_len  # scans do not grow the program with their length
+    return int(_BASE_BYTES + _TABLE_BYTES_PER_CELL * n_table_cells
+               + _BYTES_PER_LANE * lanes)
+
+
+def candidate_configs(total_steps: int, dp: int,
+                      max_lanes: int) -> List[Tuple[int, int]]:
+    """(lanes, segment_len) factorisations of one update's
+    ``total_steps`` batch, smallest-estimated-program (fewest lanes)
+    first. Lanes must divide the batch, stay within ``max_lanes`` (the
+    requested num_envs — more lanes than asked would change workload
+    semantics upward), and divide evenly over the mesh's ``dp`` axis so
+    sharded collection stays collective-free."""
+    out = []
+    for lanes in range(1, max_lanes + 1):
+        if total_steps % lanes:
+            continue
+        if dp > 1 and lanes % dp:
+            continue
+        out.append((lanes, total_steps // lanes))
+    out.sort(key=lambda ls: ls[0])
+    return out
+
+
+def workload_signature(et, total_steps: int, updates_per_epoch: int,
+                       dp: int, max_lanes: int = 0,
+                       extra: str = "") -> str:
+    """Cache key for the autotuned config: everything the compiled
+    program's size depends on — pad bounds, topology size, the
+    model/degree config set, batch factorisation inputs (including the
+    lane cap: a cached config must never carry more lanes than the
+    current run's num_envs allows), mesh width — hashed so a changed
+    workload can never serve a stale config."""
+    pads = dataclasses.asdict(et.pads)
+    payload = json.dumps({
+        "pads": pads, "n_srv": et.n_srv, "n_chan": et.n_chan,
+        "types": list(et.types), "degrees": list(et.degrees),
+        "max_action": et.max_action, "total_steps": total_steps,
+        "updates_per_epoch": updates_per_epoch, "dp": dp,
+        "max_lanes": max_lanes, "extra": extra}, sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+def _cache_path(probe_dir: str) -> str:
+    return os.path.join(probe_dir, AUTOTUNE_CACHE_FILE)
+
+
+def load_cached_config(probe_dir: str, key: str) -> Optional[dict]:
+    """Best-effort read of a cached autotune decision (missing/corrupt
+    cache means probe again — never an error)."""
+    try:
+        with open(_cache_path(probe_dir)) as f:
+            return json.load(f).get(key)
+    except (OSError, ValueError):
+        return None
+
+
+def store_cached_config(probe_dir: str, key: str, entry: dict) -> None:
+    """Best-effort atomic upsert of one autotune decision."""
+    path = _cache_path(probe_dir)
+    try:
+        os.makedirs(probe_dir, exist_ok=True)
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[key] = entry
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _run_bounded(fn: Callable, timeout_s: float,
+                 label: str) -> Tuple[bool, object, Optional[str]]:
+    """Run ``fn`` on a daemon worker thread, joined with ``timeout_s``:
+    an in-process axon call that wedges cannot be interrupted from
+    Python (CLAUDE.md), so on timeout the thread is abandoned and the
+    step reported failed. Returns (ok, value, error)."""
+    import threading
+
+    box: dict = {}
+
+    def _work():
+        try:
+            box["value"] = fn()
+            box["ok"] = True
+        except Exception as e:  # remote_compile rejection, OOM, ...
+            box["ok"] = False
+            box["error"] = f"{type(e).__name__}: {e}"
+
+    t = threading.Thread(target=_work, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return False, None, f"{label} exceeded {timeout_s:.0f}s (abandoned)"
+    return bool(box.get("ok")), box.get("value"), box.get("error")
+
+
+def probe_compile(build_fn: Callable[[], "FusedEpochDriver"], state,
+                  timeout_s: float
+                  ) -> Tuple[Optional["FusedEpochDriver"], bool,
+                             Optional[int], Optional[str]]:
+    """Build + compile one candidate's fused program with a bounded
+    wall timeout covering BOTH steps: driver construction itself
+    dispatches device work (bank device_put, the vmapped segment_init)
+    that can wedge on the tunnel exactly like a compile, so it runs on
+    the same bounded worker. On success the compiled executable is
+    already in the jit cache — the first training epoch pays no second
+    compile. Returns (driver, ok, actual_program_bytes, error).
+    """
+    size_box: dict = {}
+
+    def _work():
+        driver = build_fn()
+        lowered = driver.lower(state)
+        try:
+            size_box["size"] = len(lowered.as_text())
+        except Exception:
+            size_box["size"] = None
+        lowered.compile()
+        return driver
+
+    ok, driver, err = _run_bounded(_work, timeout_s, "compile")
+    return driver, ok, size_box.get("size"), err
+
+
+def autotune_fused(build_driver: Callable[[int, int],
+                                          "FusedEpochDriver"],
+                   state, et, total_steps: int, updates_per_epoch: int,
+                   dp: int, max_lanes: int,
+                   probe_dir: Optional[str] = None,
+                   probe_timeout_s: float = 240.0,
+                   signature_extra: str = "",
+                   lanes: Optional[int] = None,
+                   segment_len: Optional[int] = None
+                   ) -> Tuple[Optional["FusedEpochDriver"],
+                              AutotuneResult]:
+    """Pick a compilable (lanes, segment_len) config and build its
+    driver.
+
+    Explicit ``lanes``/``segment_len`` skip probing entirely (tests,
+    pinned production configs). Otherwise: cache hit → build that config
+    without probing (the gate stays deterministic given the cached
+    config — multi-host rule); cache miss → probe-compile candidates
+    smallest-estimated-first under the caller-held chip lock, cache the
+    winner. Returns (driver, result); driver is None when nothing
+    compiled — the caller must fall back to ``loop_mode="pipelined"``
+    LOUDLY (never silently).
+    """
+    probe_dir = probe_dir or default_probe_dir()
+    cells = table_cells(et)
+    if lanes is not None or segment_len is not None:
+        if lanes is None or segment_len is None:
+            raise ValueError("pass both lanes and segment_len (or "
+                             "neither, for autotuning)")
+        if lanes * segment_len != total_steps:
+            raise ValueError(
+                f"lanes ({lanes}) x segment_len ({segment_len}) must "
+                f"equal the per-update batch ({total_steps})")
+        # construction dispatches device work — bound it like a probe
+        ok, driver, err = _run_bounded(
+            lambda: build_driver(lanes, segment_len), probe_timeout_s,
+            "driver build")
+        if not ok:
+            raise RuntimeError(
+                f"fused driver build failed for the explicit config "
+                f"(lanes={lanes}, segment_len={segment_len}): {err}")
+        return driver, AutotuneResult(
+            lanes=lanes, segment_len=segment_len,
+            estimated_bytes=estimate_program_bytes(lanes, segment_len,
+                                                   cells),
+            actual_bytes=None, source="explicit")
+
+    key = workload_signature(et, total_steps, updates_per_epoch, dp,
+                             max_lanes=max_lanes, extra=signature_extra)
+    cached = load_cached_config(probe_dir, key)
+    if cached is not None:
+        # a hand-edited/corrupt entry is re-probed, never obeyed: the
+        # cached config must satisfy every constraint the prober
+        # enforces (lane cap, exact batch factorisation, dp divide)
+        cl = int(cached.get("lanes", 0))
+        cs = int(cached.get("segment_len", 0))
+        if (cl < 1 or cl > max_lanes or cl * cs != total_steps
+                or (dp > 1 and cl % dp)):
+            cached = None
+    if cached is not None:
+        cl, cs = int(cached["lanes"]), int(cached["segment_len"])
+        ok, driver, err = _run_bounded(lambda: build_driver(cl, cs),
+                                       probe_timeout_s, "driver build")
+        if ok:
+            return driver, AutotuneResult(
+                lanes=cl, segment_len=cs,
+                estimated_bytes=int(cached.get("estimated_bytes", 0)),
+                actual_bytes=cached.get("actual_bytes"),
+                source="cache")
+        # a wedged build on the cached config would wedge probing too
+        return None, AutotuneResult(
+            lanes=0, segment_len=0, estimated_bytes=0,
+            actual_bytes=None, source="failed",
+            probed=[(cl, cs, False, err)])
+
+    probed: List[Tuple[int, int, bool, Optional[str]]] = []
+    for cand_lanes, cand_seg in candidate_configs(total_steps, dp,
+                                                  max_lanes):
+        driver, ok, size, err = probe_compile(
+            lambda cl=cand_lanes, cs=cand_seg: build_driver(cl, cs),
+            state, probe_timeout_s)
+        probed.append((cand_lanes, cand_seg, ok, err))
+        if not ok and err and "abandoned" in err:
+            # a TIMED-OUT build/compile was the smallest remaining
+            # candidate (size-ranked): larger ones cannot fare better,
+            # and the abandoned worker thread is still burning CPU —
+            # stop probing instead of stacking more of them
+            break
+        if ok:
+            est = estimate_program_bytes(cand_lanes, cand_seg, cells)
+            store_cached_config(probe_dir, key, {
+                "lanes": cand_lanes, "segment_len": cand_seg,
+                "estimated_bytes": est, "actual_bytes": size})
+            return driver, AutotuneResult(
+                lanes=cand_lanes, segment_len=cand_seg,
+                estimated_bytes=est, actual_bytes=size, source="probe",
+                probed=probed)
+    return None, AutotuneResult(
+        lanes=0, segment_len=0, estimated_bytes=0, actual_bytes=None,
+        source="failed", probed=probed)
+
+
+# -------------------------------------------------------------------------
+# The fused epoch driver.
+# -------------------------------------------------------------------------
+
+def horizon_bank_jobs(env, seed: int,
+                      explicit: Optional[int] = None) -> int:
+    """Jobs per lane bank: the explicit config when given, else sized to
+    cover the sim horizon — the ONE sizing home for the device
+    collector, the fused loop, and the bench (an under-sized bank ends
+    in-kernel episodes early: arrival_t=inf silently truncates them).
+
+    Sizing provisions for the SUM of interarrivals, not its mean: a
+    heavy-tailed distribution can draw a lighter-than-mean bank and
+    exhaust early, so a 2-sigma CLT margin on the horizon's arrival
+    count rides on top of 10% slack. The process-global numpy rng the
+    distributions draw from is snapshotted/restored, so sizing never
+    perturbs a caller's stochastic streams."""
+    if explicit:
+        return int(explicit)
+    msrt = float(env.max_simulation_run_time)
+    if not np.isfinite(msrt):
+        raise ValueError(
+            "device/fused collection with an unbounded "
+            "max_simulation_run_time needs an explicit "
+            "algo_config device_bank_jobs")
+    rng_state = np.random.get_state()
+    try:
+        np.random.seed(seed)
+        ias = np.array([env.cluster.jobs_generator
+                        .interarrival_dist.sample()
+                        for _ in range(1000)], np.float64)
+    finally:
+        np.random.set_state(rng_state)
+    mean = max(float(ias.mean()), 1e-9)
+    base = msrt / mean
+    return int(base * 1.1
+               + 2.0 * (float(ias.std()) / mean) * np.sqrt(base)) + 10
+
+
+def stacked_job_banks(et, env, n_lanes: int, n_jobs: int,
+                      seed_base: int = 0) -> Dict:
+    """Per-lane job banks sampled from ``env``'s own workload machinery,
+    stacked along a leading lane axis. Lane i draws with seed
+    ``seed_base + 7559 * i + 17`` — THE device-collection seed formula
+    (one home: the training loop and the bench both build their banks
+    here, so fused lanes == num_envs reproduce the device collector's
+    banks bit-for-bit and the two callers can never drift)."""
+    import jax.numpy as jnp
+
+    from ddls_tpu.sim.jax_env import sample_job_bank
+
+    banks = [sample_job_bank(et, env, n_jobs, seed_base + 7559 * i + 17)
+             for i in range(n_lanes)]
+    return {k: jnp.asarray(np.stack([b[k] for b in banks]))
+            for k in banks[0]}
+
+
+#: the compact episode-counter trace keys the fused program returns per
+#: decision step (the rest of the segment trace — obs fields, actions —
+#: stays INSIDE the program; only these [U, B, T] scalars ever leave)
+EPISODE_TRACE_KEYS = ("done", "ep_return", "ep_blocked", "ep_completed",
+                      "ep_arrived")
+
+
+class FusedEpochDriver:
+    """One jitted collect→update epoch over the in-kernel environment.
+
+    Counterpart of `DevicePPOCollector` + the standalone jitted
+    ``train_step``, fused: ``fused_epoch(state, rngs)`` scans
+    ``updates_per_epoch`` rounds of [segment_len, num_lanes] collection
+    + one update each, entirely on device. ``train_step_fn(state, traj,
+    last_values, rng) -> (state, metrics)`` is the learner's UNJITTED
+    update (e.g. ``PPOLearner._train_step``) so it traces into the
+    epoch program; ``state_shardings`` mirrors the standalone jit's
+    in/out shardings so the in-scan update partitions identically (the
+    x64 parity contract).
+
+    The simulator state is carried on device ACROSS epochs (episodes
+    span epoch boundaries exactly as they span the sequential
+    collector's segments); per-lane episode lengths are tracked
+    host-side and consumed by ``harvest_episodes`` at drain boundaries.
+    """
+
+    def __init__(self, et, ot, model, banks: Dict, segment_len: int,
+                 updates_per_epoch: int, train_step_fn: Callable,
+                 state_shardings=None, mesh=None):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ddls_tpu.models.policy import batched_policy_apply
+        from ddls_tpu.rl.ppo import traj_donate_argnums
+        from ddls_tpu.sim.jax_env import (_kernel_obs, make_segment_fn,
+                                          segment_init, vmap_segment_fn)
+
+        self.et, self.ot, self.model = et, ot, model
+        self.segment_len = int(segment_len)
+        self.updates_per_epoch = int(updates_per_epoch)
+        self.num_lanes = int(
+            jax.tree_util.tree_leaves(banks)[0].shape[0])
+        self.mesh = mesh
+        self.env_steps_per_epoch = (self.updates_per_epoch
+                                    * self.segment_len * self.num_lanes)
+        T, B, U = self.segment_len, self.num_lanes, self.updates_per_epoch
+        # trace_obs: the in-scan update carry — the update consumes the
+        # segment's own observations instead of re-deriving them from
+        # the compact fields (a second _kernel_obs sweep over T x B
+        # samples, measured ~30% of the fused epoch on CPU); same
+        # _kernel_obs values either way, so parity with the sequential
+        # rebuild-from-fields path is unchanged
+        segment = make_segment_fn(et, ot, model, T, trace_obs=True)
+        # one-lane fast path shared with DevicePPOCollector (a 1-wide
+        # vmap halves the kernel's XLA:CPU throughput)
+        lane_segment = vmap_segment_fn(segment, self.num_lanes)
+
+        lane = repl = None
+        if mesh is not None:
+            if B % mesh.shape["dp"] != 0:
+                raise ValueError(
+                    f"num_lanes {B} must divide over the mesh dp axis "
+                    f"({mesh.shape['dp']})")
+            lane = NamedSharding(mesh, P("dp"))
+            repl = NamedSharding(mesh, P())
+            banks = jax.device_put(banks, lane)
+            batch_time = NamedSharding(mesh, P(None, "dp"))
+            batch_only = NamedSharding(mesh, P("dp"))
+        self._banks = banks
+        # per-lane initial sim state from each lane's OWN bank; carried
+        # across fused_epoch calls like the collector's self._state
+        self._state = jax.vmap(lambda b: segment_init(et, b))(banks)
+        self._ep_len = np.zeros(B, np.int64)
+
+        def obs_from_fields(jtype, frac, steps, n_occ, n_run):
+            return _kernel_obs(ot, et, jtype, frac, steps, n_occ, n_run)
+
+        def traj_from_trace(trace):
+            """The exact DevicePPOCollector.collect staging, traced:
+            [B, T] kernel trace -> [T, B] learner traj with the same
+            f64-then-f32 casts as the host path. The obs ride the trace
+            (``trace_obs`` carry) — bit-equal to the host path's
+            rebuild-from-fields, which vmaps the same `_kernel_obs`."""
+            def tb(x):
+                return jnp.swapaxes(x, 0, 1)
+
+            return {
+                "obs": {k: tb(v) for k, v in trace["obs"].items()},
+                "actions": tb(trace["action"]).astype(jnp.int32),
+                "logp": tb(trace["logp"]).astype(jnp.float32),
+                "values": tb(trace["value"]).astype(jnp.float32),
+                "rewards": tb(trace["reward"]).astype(jnp.float32),
+                "dones": tb(trace["done"]),
+            }
+
+        def one_round(carry, _):
+            state, sim_state, crng, urng = carry
+            # rng bookkeeping mirrors RLEpochLoop._split_collect_rng /
+            # _split_rng exactly: same streams, same per-round splits,
+            # so fused and sequential updates consume identical keys
+            crng, csub = jax.random.split(crng)
+            lane_rngs = jax.random.split(csub, B)
+            sim_state, trace, next_fields = lane_segment(
+                self._banks, state.params, sim_state, lane_rngs)
+            traj = traj_from_trace(trace)
+            next_obs = jax.vmap(obs_from_fields)(
+                next_fields["jtype"], next_fields["frac"],
+                next_fields["steps"], next_fields["n_occupied"],
+                next_fields["n_running"])
+            _, last_values = batched_policy_apply(model, state.params,
+                                                  next_obs)
+            last_values = last_values.astype(jnp.float32)
+            if mesh is not None:
+                # pin the staged batch to the standalone train_step's
+                # in_shardings so the in-scan update partitions (and
+                # therefore rounds) identically to the sequential path
+                traj = jax.lax.with_sharding_constraint(
+                    traj, jax.tree_util.tree_map(
+                        lambda _: batch_time, traj))
+                last_values = jax.lax.with_sharding_constraint(
+                    last_values, batch_only)
+            urng, usub = jax.random.split(urng)
+            state, metrics = train_step_fn(state, traj, last_values,
+                                           usub)
+            ep = {k: trace[k] for k in EPISODE_TRACE_KEYS}
+            return (state, sim_state, crng, urng), (metrics, ep)
+
+        def epoch(state, sim_state, crng, urng):
+            (state, sim_state, crng, urng), (metrics, ep) = jax.lax.scan(
+                one_round, (state, sim_state, crng, urng), None,
+                length=U)
+            return state, sim_state, crng, urng, metrics, ep
+
+        if mesh is not None:
+            sharded_sim = jax.tree_util.tree_map(lambda _: lane,
+                                                 self._state)
+            # episode-counter outputs are [U, B, T]: B on axis 1
+            ep_sh = NamedSharding(mesh, P(None, "dp"))
+            state_sh = (state_shardings if state_shardings is not None
+                        else repl)
+            self._jit_epoch = jax.jit(
+                epoch,
+                in_shardings=(state_sh, sharded_sim, repl, repl),
+                out_shardings=(state_sh, sharded_sim, repl, repl, repl,
+                               ep_sh),
+                donate_argnums=traj_donate_argnums(0, 1))
+        else:
+            self._jit_epoch = jax.jit(
+                epoch, donate_argnums=traj_donate_argnums(0, 1))
+
+    # ------------------------------------------------------------- run
+    def lower(self, state):
+        """Lower (trace, no compile/execute) the fused program for the
+        autotuner's probe-compile and size measurement."""
+        import jax
+
+        crng = urng = jax.random.PRNGKey(0)
+        return self._jit_epoch.lower(state, self._state, crng, urng)
+
+    def fused_epoch(self, state, rngs: Tuple):
+        """ONE device dispatch: ``updates_per_epoch`` collect→update
+        rounds. ``rngs`` is (collect_rng, update_rng); both are split
+        in-kernel with the host loop's exact bookkeeping and returned
+        advanced. Returns (state, (collect_rng, update_rng),
+        metrics [U]-stacked dict, episode_trace dict of [U, B, T]) —
+        ALL device values; no transfer happens here (the LazyMetrics /
+        episode-drain boundaries fetch later, batched).
+        """
+        crng, urng = rngs
+        (state, self._state, crng, urng, metrics,
+         ep) = self._jit_epoch(state, self._state, crng, urng)
+        return state, (crng, urng), metrics, ep
+
+    # --------------------------------------------------------- harvest
+    def harvest_episodes(self, ep_trace) -> list:
+        """Episode records from a FETCHED [U, B, T] episode-counter
+        trace (the drain boundary hands host numpy arrays) — the same
+        records, in the same (round, t, b) order, as
+        ``DevicePPOCollector._harvest_episodes`` emits across U
+        sequential collects, using the host denominators
+        (cluster.py:1020-1023)."""
+        episodes = []
+        done = np.asarray(ep_trace["done"])
+        U, B, T = done.shape
+        for u in range(U):
+            for t in range(T):
+                self._ep_len += 1
+                for b in np.nonzero(done[u, :, t])[0]:
+                    blk = int(ep_trace["ep_blocked"][u, b, t])
+                    com = int(ep_trace["ep_completed"][u, b, t])
+                    arr = int(ep_trace["ep_arrived"][u, b, t])
+                    episodes.append({
+                        "env_index": int(b),
+                        "episode_return": float(
+                            ep_trace["ep_return"][u, b, t]),
+                        "episode_length": int(self._ep_len[b]),
+                        "num_jobs_arrived": arr,
+                        "num_jobs_completed": com,
+                        "num_jobs_blocked": blk,
+                        "acceptance_rate": com / arr if arr else 0.0,
+                        "blocking_rate": blk / arr if arr else 0.0,
+                    })
+                    self._ep_len[b] = 0
+        return episodes

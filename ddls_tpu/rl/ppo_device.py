@@ -39,13 +39,15 @@ class DevicePPOCollector:
         import jax.numpy as jnp
 
         from ddls_tpu.rl.ppo import traj_donate_argnums
-        from ddls_tpu.sim.jax_env import make_segment_fn, segment_init
+        from ddls_tpu.sim.jax_env import (make_segment_fn, segment_init,
+                                          vmap_segment_fn)
 
         self.et, self.ot, self.model = et, ot, model
         self.rollout_length = rollout_length
         self.num_envs = int(jax.tree_util.tree_leaves(banks)[0].shape[0])
         self.mesh = mesh
         segment = make_segment_fn(et, ot, model, rollout_length)
+        lane_segment = vmap_segment_fn(segment, self.num_envs)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -64,15 +66,36 @@ class DevicePPOCollector:
             # the per-lane sim state (CPU donation disabled — it forces
             # inline execution of the jitted call, ppo.traj_donate_argnums)
             self._vseg = jax.jit(
-                jax.vmap(segment, in_axes=(0, None, 0, 0)),
+                lane_segment,
                 in_shardings=(lane, repl, lane, lane),
                 out_shardings=(lane, lane, lane),
                 donate_argnums=traj_donate_argnums(2))
         else:
-            self._vseg = jax.jit(jax.vmap(segment,
-                                          in_axes=(0, None, 0, 0)),
+            self._vseg = jax.jit(lane_segment,
                                  donate_argnums=traj_donate_argnums(2))
         self.banks = banks
+        # jitted bootstrap-value forward: one compiled dispatch per
+        # collect instead of an eager op-by-op chain — and the SAME
+        # compiled math as the fused epoch's in-scan bootstrap
+        # (rl/fused.py), whose x64 parity pin requires the two paths to
+        # round identically. Two ingredients of that bit-equality:
+        # jitted not eager (eager fuses nothing and differs at the last
+        # f32 ulp), and the same PARTITIONING — under a mesh the fused
+        # bootstrap consumes lane-sharded obs, so the standalone one
+        # must shard its batch axis identically or the partitioned
+        # segment-sum accumulation order diverges
+        from ddls_tpu.models.policy import batched_policy_apply
+
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._jit_apply = jax.jit(
+                lambda p, o: batched_policy_apply(model, p, o),
+                in_shardings=(NamedSharding(mesh, P()),
+                              NamedSharding(mesh, P("dp"))))
+        else:
+            self._jit_apply = jax.jit(
+                lambda p, o: batched_policy_apply(model, p, o))
         # per-env initial state from each env's OWN bank (arrival clocks
         # differ across banks)
         self._state = jax.vmap(lambda b: segment_init(et, b))(banks)
@@ -86,7 +109,6 @@ class DevicePPOCollector:
         plus bootstrap values."""
         import jax
 
-        from ddls_tpu.models.policy import batched_policy_apply
         from ddls_tpu.sim.jax_env import rebuild_obs_batch
 
         rngs = jax.random.split(rng, self.num_envs)
@@ -106,7 +128,7 @@ class DevicePPOCollector:
         }
         next_obs = rebuild_obs_batch(self.et, self.ot, {
             k: np.asarray(v) for k, v in next_fields.items()})
-        _, last_values = batched_policy_apply(self.model, params, {
+        _, last_values = self._jit_apply(params, {
             k: np.asarray(v) for k, v in next_obs.items()})
         return {"traj": traj,
                 "last_values": np.asarray(last_values, np.float32),

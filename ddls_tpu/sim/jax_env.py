@@ -1477,7 +1477,8 @@ def segment_init(et: EpisodeTables, bank):
     return _episode_kernels(et).init_state(bank)
 
 
-def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
+def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
+                    trace_obs: bool = False):
     """(bank, params, sim_state, rng) -> (new_sim_state, trace, next_fields)
 
     Exactly ``n_steps`` policy decisions per call — the [T, B] segment
@@ -1491,6 +1492,18 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
     exact observation on host for the learner's re-forward.
     ``next_fields`` are the same fields for the bootstrap state after the
     segment.
+
+    ``trace_obs=True`` additionally carries the FULL observation dict the
+    in-scan policy forward consumed (``trace["obs"]``) — the in-scan
+    update carry for the fused epoch (rl/fused.py): its learner update
+    reads the segment's own obs instead of re-deriving them from the
+    compact fields, skipping a second `_kernel_obs` sweep over T x B
+    samples. The values are the SAME `_kernel_obs` outputs either way
+    (one function, elementwise per sample), so the fused x64 parity
+    against the rebuild-from-fields path stays exact; host collectors
+    keep ``trace_obs=False`` — shipping full padded obs through the
+    per-collect device->host fetch is precisely what the compact trace
+    exists to avoid.
     """
     import jax
     import jax.numpy as jnp
@@ -1570,6 +1583,8 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
                    # (tests/test_jax_policy_episode.py)
                    "ep_arrived": ptr3,
                    **fields}
+            if trace_obs:
+                out["obs"] = obs
             return state4, out
 
         rngs = jax.random.split(rng, n_steps)
@@ -1577,6 +1592,32 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int):
         return final, trace, obs_fields(bank, final)
 
     return jax.jit(segment)
+
+
+def vmap_segment_fn(segment, n_lanes: int):
+    """Lane-batched wrapper of a `make_segment_fn` kernel:
+    ``(banks [B,...], params, states [B,...], rngs [B]) -> outputs with
+    a leading B axis``. Real lane counts vmap; ONE lane takes a
+    squeeze/expand fast path instead — batching a singleton lane axis
+    through the decision kernels costs ~2x on XLA:CPU (measured
+    docs/perf_round8.md: 738 -> 392 decisions/s at the degree-2 bench
+    regime), and a 1-wide vmap buys nothing anywhere. Shared by the
+    device collector and the fused epoch driver so the two paths stay
+    the same compiled math at every lane count."""
+    import jax
+
+    if n_lanes > 1:
+        return jax.vmap(segment, in_axes=(0, None, 0, 0))
+
+    def one_lane(banks, params, states, rngs):
+        sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)  # noqa: E731
+        state, trace, next_fields = segment(sq(banks), params,
+                                            sq(states), rngs[0])
+        ex = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda x: x[None], t)
+        return ex(state), ex(trace), ex(next_fields)
+
+    return one_lane
 
 
 def rebuild_obs_batch(et: EpisodeTables, ot: dict, fields: dict):

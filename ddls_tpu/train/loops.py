@@ -205,11 +205,35 @@ class RLEpochLoop:
       params from before epoch n's update, so host env stepping overlaps
       the device update. Learners whose update assumes fresher data
       (ppo/pg/dqn/es) reject ``pipeline_depth > 0`` loudly.
+
+    Fused mode (rl/fused.py, docs/perf_round8.md):
+
+    * ``loop_mode="fused"`` runs the whole epoch as ONE jitted program —
+      a ``lax.scan`` over ``updates_per_epoch`` collect→update rounds on
+      the in-kernel environment (the Podracer/Anakin shape; implies
+      device collection, single-process only). Learner metrics come back
+      as a [U]-stacked device dict (one ``LazyMetrics`` per epoch) and
+      episode counters as compact [U, B, T] device traces; BOTH are
+      drained per ``metrics_sync_interval`` epochs in one batched fetch
+      — never per update — so the steady-state epoch is transfer-free
+      (pinned under ``jax.transfer_guard`` in tests/test_fused.py).
+      ``fused_config`` tunes the lane/segment autotuner: ``lanes`` +
+      ``segment_len`` pin the config explicitly (skipping the
+      probe-compile), ``probe_dir``/``probe_timeout_s`` steer the
+      probing; when no candidate compiles the loop falls back to
+      ``loop_mode="pipelined"`` LOUDLY (a warning naming every probed
+      config). Learners without the scan-based in-kernel contract
+      (DQN: host replay insertion; ES: population fitness on host envs)
+      reject fused before any env construction.
     """
 
     # pipeline_depth > 0 staleness is only sound for learners with an
     # explicit off-policy correction; subclasses opt in (ImpalaEpochLoop)
     SUPPORTS_STALE_COLLECTION = False
+    # fused epochs need the shared [T, B] traj contract AND an update
+    # that traces as one pure function (state, traj, last_values, rng)
+    # -> (state, metrics); DQN/ES opt out (host replay / host fitness)
+    SUPPORTS_FUSED = True
 
     def __init__(self,
                  path_to_env_cls: str,
@@ -232,6 +256,8 @@ class RLEpochLoop:
                  metrics_sync_interval: int = 10,
                  pipeline_depth: int = 0,
                  vec_env_backend: str = "auto",
+                 updates_per_epoch: int = 4,
+                 fused_config: Optional[dict] = None,
                  path_to_model_cls: Optional[str] = None,  # config parity
                  **kwargs):
         import jax
@@ -250,11 +276,36 @@ class RLEpochLoop:
         self.seed = 0 if seed is None else int(seed)
         self.test_seed = test_seed
 
-        if loop_mode not in ("sequential", "pipelined"):
+        if loop_mode not in ("sequential", "pipelined", "fused"):
             raise ValueError(
-                f"loop_mode must be 'sequential' or 'pipelined', got "
-                f"{loop_mode!r}")
+                f"loop_mode must be 'sequential', 'pipelined' or "
+                f"'fused', got {loop_mode!r}")
+        if loop_mode == "fused" and not self.SUPPORTS_FUSED:
+            raise ValueError(
+                f"{type(self).__name__} does not support loop_mode="
+                "'fused': the fused epoch traces collection AND the "
+                "update into one program, which needs in-kernel "
+                "collection plus a pure scan-based update — DQN's "
+                "replay insertion and ES's population fitness step the "
+                "host envs by contract (use ppo/impala/pg, or "
+                "rl/es_device.py for on-device ES)")
+        if loop_mode == "fused" and jax.process_count() > 1:
+            raise ValueError(
+                "loop_mode='fused' is single-process: collection lanes "
+                "and the sharded update live in ONE program, which "
+                "would need globally-assembled bank/sim-state arrays "
+                "under multi-host (use loop_mode='pipelined' with "
+                "device_collector there)")
         self.loop_mode = loop_mode
+        self.updates_per_epoch = max(int(updates_per_epoch or 1), 1)
+        self.fused_config = dict(fused_config or {})
+        # fused runtime state: the driver, its autotune decision, the
+        # undrained compact episode-counter traces, and the chip lock
+        # held for the run on accelerator backends
+        self.fused = None
+        self.autotune_result = None
+        self._fused_episode_ring: List[Any] = []
+        self._chip_lock = None
         self.metrics_sync_interval = max(int(metrics_sync_interval or 1), 1)
         self.pipeline_depth = int(pipeline_depth or 0)
         if self.pipeline_depth < 0 or self.pipeline_depth > 1:
@@ -295,6 +346,10 @@ class RLEpochLoop:
         # (DQN, ES) reject it loudly in their _build_learner.
         self.device_collector = bool(
             (algo_config or {}).get("device_collector", False))
+        if self.loop_mode == "fused":
+            # fused collection runs the in-kernel env by construction:
+            # the same template-env/bank setup as device_collector
+            self.device_collector = True
         self.device_bank_jobs = (algo_config or {}).get("device_bank_jobs")
 
         # Multi-host: each process must collect DIFFERENT rollouts (its
@@ -393,6 +448,10 @@ class RLEpochLoop:
 
         self.learner = self._make_learner()
         self.state = self.learner.init_state(self.params)
+        if self.loop_mode == "fused":
+            self._build_fused()
+            if self.loop_mode == "fused":  # may have fallen back
+                return
         if getattr(self, "device_collector", False):
             self.collector = self._make_device_collector()
             return
@@ -401,6 +460,169 @@ class RLEpochLoop:
             deferred_fetch=(self.loop_mode == "pipelined"))
         self.collector._needs_reset = False  # env already reset in __init__
 
+    def _fused_step_fn(self):
+        """The learner's UNJITTED update for in-scan tracing inside the
+        fused epoch program, normalised to the PPO signature
+        ``(state, traj, last_values, rng) -> (state, metrics)``.
+        Learners whose update takes no rng override this to drop it
+        (the rng stream is still split per round so the update-key
+        bookkeeping matches the sequential loop exactly)."""
+        return self.learner._train_step
+
+    def _build_fused(self) -> None:
+        """Autotune a (lanes, segment_len) config and build the fused
+        epoch driver; on total probe failure fall back to
+        ``loop_mode='pipelined'`` with device collection, LOUDLY."""
+        import warnings
+
+        import jax
+
+        from ddls_tpu.rl import fused as fused_mod
+
+        env0, et, ot = self._device_tables()
+        dp = int(self.mesh.shape["dp"])
+        total = self.rollout_length * self.num_envs
+        cfg = self.fused_config
+        step_fn = self._fused_step_fn()
+        sh_fn = getattr(self.learner, "_state_shardings", None)
+        state_shardings = (sh_fn(self.state) if sh_fn is not None
+                           else getattr(self.learner, "_replicated",
+                                        None))
+
+        def build_driver(lanes, segment_len):
+            return fused_mod.FusedEpochDriver(
+                et, ot, self.model,
+                self._stacked_banks(et, env0, lanes), segment_len,
+                self.updates_per_epoch, train_step_fn=step_fn,
+                state_shardings=state_shardings, mesh=self.mesh)
+
+        # own the chip for the probing AND the whole training run (the
+        # documented wedge gotcha: a probe loop opening a second axon
+        # client against an owned chip). CPU has no chip to own, and
+        # tests must not contend on the shared lock file. Released on
+        # ANY exit that doesn't end in a fused driver — a leaked lock
+        # file would divert every later run's probes to CPU.
+        if jax.default_backend() != "cpu":
+            self._chip_lock = fused_mod.chip_lock(
+                cfg.get("probe_dir")).__enter__()
+            if not self._chip_lock.owned:
+                # a LIVE foreign owner has the chip (and no wrapper
+                # above us delegated ownership via DDLS_TPU_LOCK_OWNER):
+                # probe-compiling anyway would open the second axon
+                # client the lock exists to prevent (the multi-hour
+                # wedge). Fall back loudly instead of contending.
+                warnings.warn(
+                    "fused: chip held by another owner "
+                    "(.probe/tpu.lock); not probe-compiling against an "
+                    "owned chip — falling back to loop_mode='pipelined'"
+                    " with device collection")
+                self._chip_lock = None
+                self.loop_mode = "pipelined"
+                return
+        try:
+            driver, result = fused_mod.autotune_fused(
+                build_driver, self.state, et, total,
+                self.updates_per_epoch, dp, max_lanes=self.num_envs,
+                probe_dir=cfg.get("probe_dir"),
+                probe_timeout_s=float(cfg.get("probe_timeout_s",
+                                              240.0)),
+                signature_extra=(f"{type(self.learner).__name__}|"
+                                 f"{self.model!r}"),
+                lanes=cfg.get("lanes"),
+                segment_len=cfg.get("segment_len"))
+        except BaseException:
+            if self._chip_lock is not None:
+                self._chip_lock.__exit__()
+                self._chip_lock = None
+            raise
+        self.autotune_result = result
+        if driver is None:
+            warnings.warn(
+                "fused autotune: no (lanes, segment_len) config "
+                f"compiled within the probe budget — probed "
+                f"{[(l, s, e) for l, s, _, e in result.probed]}; "
+                "falling back to loop_mode='pipelined' with device "
+                "collection")
+            if self._chip_lock is not None:
+                self._chip_lock.__exit__()
+                self._chip_lock = None
+            # flipping the mode makes _build_learner's fused guard fall
+            # through to the device-collector build — no collector is
+            # constructed here (device_collector is already True)
+            self.loop_mode = "pipelined"
+            return
+        self.fused = driver
+
+    def _device_tables(self):
+        """Static jitted-env tables from the template env (shared by the
+        device collector and the fused epoch driver)."""
+        from ddls_tpu.sim.jax_env import (build_episode_tables,
+                                          build_obs_tables)
+
+        env0 = self.vec_env.envs[0]
+        et = build_episode_tables(env0)
+        ot = build_obs_tables(env0, et)
+        return env0, et, ot
+
+    def _device_bank_size(self, env0) -> int:
+        """Jobs per lane bank via the ONE sizing home
+        (rl/fused.py:horizon_bank_jobs): explicit config, else the sim
+        horizon with CLT margin."""
+        from ddls_tpu.rl.fused import horizon_bank_jobs
+
+        return horizon_bank_jobs(env0, self.seed + 31,
+                                 explicit=self.device_bank_jobs)
+
+    def _stacked_banks(self, et, env0, n_lanes: int):
+        """Per-lane job banks via the ONE seed-formula home
+        (rl/fused.py:stacked_job_banks — lane i keeps the seed the
+        device collector always gave env i, so fused lanes == num_envs
+        reproduce the collector's banks bit-for-bit)."""
+        from ddls_tpu.rl.fused import stacked_job_banks
+
+        return stacked_job_banks(et, env0, n_lanes,
+                                 self._device_bank_size(env0),
+                                 seed_base=self._collect_seed)
+
+    def _collection_mesh(self, n_lanes: int):
+        """The mesh lanes shard over, or None for single-device
+        collection: shard lanes over LOCAL devices when they divide
+        evenly (the pod collection shape: each chip runs its own lanes;
+        without this a multi-chip slice collects on one chip and
+        updates on all). Multi-process: a per-process LOCAL mesh keeps
+        each process's banks/rngs its own (the global mesh would demand
+        cross-process arrays) while still using every local chip."""
+        import jax
+
+        local = jax.local_devices()
+        if len(local) <= 1:
+            return None
+        # the candidate mesh is what the collector would actually
+        # shard over: the configured training mesh in single-process
+        # mode (possibly FEWER devices than the host exposes), a
+        # per-process local mesh otherwise
+        if jax.process_count() == 1:
+            candidate = self.mesh
+        else:
+            from ddls_tpu.parallel.mesh import make_mesh
+            candidate = make_mesh(len(local), devices=local)
+        # gate on the value DevicePPOCollector validates (ppo_device
+        # .py: num_envs % mesh.shape['dp']), not the local device
+        # count — e.g. n_devices=3 on an 8-device host with
+        # num_envs=8 divides the host but not the mesh, and must
+        # fall back to single-device collection instead of raising
+        # (ADVICE r5 item 1)
+        dp = int(candidate.shape["dp"])
+        if n_lanes % dp == 0:
+            return candidate
+        import warnings
+        warnings.warn(
+            f"device_collector: num_envs={n_lanes} not "
+            f"divisible by the mesh dp axis ({dp}); lanes "
+            "will collect on ONE device (set num_envs to a "
+            "multiple for sharded collection)")
+        return None
+
     def _make_device_collector(self):
         """The jitted-env collection path (algo_config
         ``device_collector: true``): per-lane job banks sampled from the
@@ -408,86 +630,14 @@ class RLEpochLoop:
         in-kernel. Serves every loop that consumes the shared traj dict
         (ppo, impala, pg). Requires the canonical-RAMP jitted env
         (sim/jax_env.py) and a priceless observation."""
-        import jax
-        import jax.numpy as jnp
-
         from ddls_tpu.rl.ppo_device import DevicePPOCollector
-        from ddls_tpu.sim.jax_env import (build_episode_tables,
-                                          build_obs_tables, sample_job_bank)
 
-        env0 = self.vec_env.envs[0]
-        et = build_episode_tables(env0)
-        ot = build_obs_tables(env0, et)
-        if self.device_bank_jobs:
-            n_jobs = int(self.device_bank_jobs)
-        else:
-            # enough arrivals to cover the sim horizon with ~10% slack
-            # (an exhausted bank would end episodes early: arrival_t=inf)
-            msrt = float(env0.max_simulation_run_time)
-            if not np.isfinite(msrt):
-                raise ValueError(
-                    "device_collector with an unbounded "
-                    "max_simulation_run_time needs an explicit "
-                    "algo_config device_bank_jobs")
-            rng_state = np.random.get_state()
-            try:
-                np.random.seed(self.seed + 31)
-                ias = np.array([env0.cluster.jobs_generator
-                                .interarrival_dist.sample()
-                                for _ in range(1000)], np.float64)
-            finally:
-                np.random.set_state(rng_state)
-            mean = max(float(ias.mean()), 1e-9)
-            base = msrt / mean
-            # provision for the sum of interarrivals, not its mean: a
-            # heavy-tailed distribution can draw a lighter-than-mean bank
-            # and exhaust early (silently truncating in-kernel episodes),
-            # so add a 2-sigma CLT margin on the horizon's arrival count
-            # plus 10% slack
-            n_jobs = int(base * 1.1
-                         + 2.0 * (float(ias.std()) / mean) * np.sqrt(base)
-                         ) + 10
-        banks = [sample_job_bank(et, env0, n_jobs,
-                                 self._collect_seed + 7559 * i + 17)
-                 for i in range(self.num_envs)]
-        stacked = {k: jnp.asarray(np.stack([b[k] for b in banks]))
-                   for k in banks[0]}
-        # shard lanes over LOCAL devices when they divide evenly (the
-        # pod collection shape: each chip runs its own lanes; without
-        # this a multi-chip slice collects on one chip and updates on
-        # all). Multi-process: a per-process LOCAL mesh keeps each
-        # process's banks/rngs its own (the global mesh would demand
-        # cross-process arrays) while still using every local chip
-        mesh = None
-        local = jax.local_devices()
-        if len(local) > 1:
-            # the candidate mesh is what the collector would actually
-            # shard over: the configured training mesh in single-process
-            # mode (possibly FEWER devices than the host exposes), a
-            # per-process local mesh otherwise
-            if jax.process_count() == 1:
-                candidate = self.mesh
-            else:
-                from ddls_tpu.parallel.mesh import make_mesh
-                candidate = make_mesh(len(local), devices=local)
-            # gate on the value DevicePPOCollector validates (ppo_device
-            # .py: num_envs % mesh.shape['dp']), not the local device
-            # count — e.g. n_devices=3 on an 8-device host with
-            # num_envs=8 divides the host but not the mesh, and must
-            # fall back to single-device collection instead of raising
-            # (ADVICE r5 item 1)
-            dp = int(candidate.shape["dp"])
-            if self.num_envs % dp == 0:
-                mesh = candidate
-            else:
-                import warnings
-                warnings.warn(
-                    f"device_collector: num_envs={self.num_envs} not "
-                    f"divisible by the mesh dp axis ({dp}); lanes "
-                    "will collect on ONE device (set num_envs to a "
-                    "multiple for sharded collection)")
+        env0, et, ot = self._device_tables()
+        stacked = self._stacked_banks(et, env0, self.num_envs)
         return DevicePPOCollector(et, ot, self.model, stacked,
-                                  self.rollout_length, mesh=mesh)
+                                  self.rollout_length,
+                                  mesh=self._collection_mesh(
+                                      self.num_envs))
 
     # ----------------------------------------------------------------- epoch
     def _split_rng(self):
@@ -630,6 +780,67 @@ class RLEpochLoop:
         boundary)."""
         self._maybe_sync_metrics(force=True)
 
+    # ------------------------------------------------------- fused epoch
+    def _maybe_drain_fused_episodes(self, force: bool = False
+                                    ) -> List[dict]:
+        """Drain the fused epochs' compact episode-counter traces in ONE
+        batched fetch and harvest episode records, at the SAME sync
+        boundaries as the metrics ring (every ``metrics_sync_interval``
+        epochs, an eval epoch, or ``force``) — never per update. The
+        gate is deterministic (epoch counter + config only — multi-host
+        rules)."""
+        if not self._fused_episode_ring:
+            return []
+        is_eval = bool(self.evaluation_interval
+                       and self.epoch_counter
+                       % self.evaluation_interval == 0)
+        if not (force or is_eval
+                or self.epoch_counter % self.metrics_sync_interval == 0):
+            return []
+        import jax
+
+        ring, self._fused_episode_ring = self._fused_episode_ring, []
+        with telemetry.span("train.host_sync"):
+            fetched = jax.device_get(ring)
+        episodes: List[dict] = []
+        for ep in fetched:
+            episodes.extend(self.fused.harvest_episodes(ep))
+        return episodes
+
+    def _run_fused(self) -> Dict[str, Any]:
+        """One fused epoch: ONE device dispatch runs
+        ``updates_per_epoch`` collect→update rounds (`rl/fused.py`).
+        Metrics ride the epoch as a [U]-stacked LazyMetrics future and
+        episode counters as a pending device trace; both drain per
+        ``metrics_sync_interval`` under ``train.host_sync`` — the
+        steady-state epoch performs NO device→host transfer. Episode
+        summaries therefore appear on drain epochs (covering every
+        epoch since the last drain), not per epoch."""
+        from ddls_tpu.train.metrics import LazyMetrics
+
+        start = time.time()
+        with telemetry.span("train.fused_epoch"):
+            (self.state, (self._collect_rng, self._rng), metrics,
+             ep) = self.fused.fused_epoch(
+                self.state, (self._collect_rng, self._rng))
+        self.epoch_counter += 1
+        env_steps = self.fused.env_steps_per_epoch
+        self.total_env_steps += env_steps
+        lazy = LazyMetrics(
+            metrics, reduce="mean",
+            extras={"num_updates": self.fused.updates_per_epoch})
+        self._metrics_ring.append(lazy)
+        self._fused_episode_ring.append(ep)
+        self._maybe_sync_metrics()
+        episodes = self._maybe_drain_fused_episodes()
+        results: Dict[str, Any] = {
+            "epoch_counter": self.epoch_counter,
+            "env_steps_this_iter": env_steps,
+            "total_env_steps": self.total_env_steps,
+            "learner": lazy,
+        }
+        return self._finalize_results(results, episodes, start)
+
     def run(self) -> Dict[str, Any]:
         """Collect one trajectory batch and apply one PPO update.
 
@@ -640,6 +851,8 @@ class RLEpochLoop:
         boundary, with ``train.update_device`` (monitor thread) carrying
         the true device wall of the update (the attribution
         Podracer/MSRL instrument for)."""
+        if self.loop_mode == "fused":
+            return self._run_fused()
         start = time.time()
         out, straj, slv = self._next_batch()
         update_t0 = telemetry.clock_now() if telemetry.enabled() else 0.0
@@ -905,6 +1118,15 @@ class RLEpochLoop:
                 executor.shutdown(wait=True)
         self._collect_executor = self._watch_executor = None
         self.sync_metrics()
+        # the final undrained interval's fused episode records are
+        # harvested (completed episodes must not vanish with the loop);
+        # no run() remains to return them, so they land on
+        # ``undrained_episodes`` for callers that aggregate records
+        self.undrained_episodes = self._maybe_drain_fused_episodes(
+            force=True)
+        if self._chip_lock is not None:
+            self._chip_lock.__exit__()
+            self._chip_lock = None
         self.vec_env.close()
 
 
@@ -913,6 +1135,10 @@ class ApexDQNEpochLoop(RLEpochLoop):
     prioritised replay buffer + jitted double/dueling DQN updates on the
     mesh (reference trains the same env through RLlib's ApexTrainer,
     algo/apex_dqn.yaml; see ddls_tpu.rl.dqn for the TPU-native redesign)."""
+
+    # replay insertion + epsilon schedules step the HOST envs; a fused
+    # in-kernel epoch cannot express them (rejected loudly in __init__)
+    SUPPORTS_FUSED = False
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.dqn_cfg = dqn_config_from_rllib(algo_config)
@@ -1160,6 +1386,14 @@ class ImpalaEpochLoop(RLEpochLoop):
 
         return ImpalaLearner(self.apply_fn, self.impala_cfg, self.mesh)
 
+    def _fused_step_fn(self):
+        # V-trace update takes no rng; the per-round key split still
+        # happens in-kernel so the stream bookkeeping matches the
+        # sequential loop (which also splits then ignores the key)
+        step = self.learner._train_step
+        return lambda state, traj, last_values, rng: step(
+            state, traj, last_values)
+
 
 class PGEpochLoop(RLEpochLoop):
     """Vanilla policy-gradient epoch loop (reference: algo/pg.yaml)."""
@@ -1174,6 +1408,11 @@ class PGEpochLoop(RLEpochLoop):
 
         return PGLearner(self.apply_fn, self.pg_cfg, self.mesh)
 
+    def _fused_step_fn(self):
+        step = self.learner._train_step  # REINFORCE update takes no rng
+        return lambda state, traj, last_values, rng: step(
+            state, traj, last_values)
+
 
 class ESEpochLoop(RLEpochLoop):
     """Evolution-strategies epoch loop (reference: algo/es.yaml).
@@ -1184,6 +1423,10 @@ class ESEpochLoop(RLEpochLoop):
     rank-shaped ES update on device. ``num_envs`` is the population size
     and must be even.
     """
+
+    # population fitness steps the HOST envs (the fully on-device ES
+    # path is rl/es_device.py); fused epochs are rejected loudly
+    SUPPORTS_FUSED = False
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.es_cfg = es_config_from_rllib(algo_config)

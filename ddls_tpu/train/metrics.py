@@ -38,10 +38,14 @@ class LazyMetrics(Mapping):
     """Mapping over scalar training metrics with deferred device→host.
 
     ``device_metrics`` is either one dict of device (or host) scalars,
-    or a LIST of such dicts with ``reduce="mean"`` (the DQN epoch shape:
-    many updates per epoch, logged as their per-key mean). ``extras``
-    are host-side scalars (counters the loop already owns) merged in at
-    materialisation and readable/writable without any device traffic.
+    or — with ``reduce="mean"`` — a LIST of such dicts (the DQN epoch
+    shape: many updates per epoch, logged as their per-key mean) or one
+    dict of ``[U]``-STACKED device arrays (the fused epoch shape,
+    rl/fused.py: a ``lax.scan`` stacks each update's metrics, and the
+    whole epoch's dict is fetched in one transfer then averaged).
+    ``extras`` are host-side scalars (counters the loop already owns)
+    merged in at materialisation and readable/writable without any
+    device traffic.
     """
 
     __slots__ = ("_device", "_host", "_extras", "_reduce", "_lock")
@@ -86,6 +90,12 @@ class LazyMetrics(Mapping):
         import numpy as np
 
         if self._reduce == "mean":
+            if isinstance(fetched, dict):
+                # fused-epoch shape: one dict of [U]-stacked arrays;
+                # accumulate in f64 exactly like the list path below
+                # (float(v) per update, then a python-float mean)
+                return {k: float(np.mean(np.asarray(v, np.float64)))
+                        for k, v in fetched.items()}
             dicts = [{k: float(v) for k, v in d.items()} for d in fetched]
             return {k: float(np.mean([d[k] for d in dicts]))
                     for k in (dicts[0] if dicts else {})}
@@ -122,7 +132,8 @@ class LazyMetrics(Mapping):
     def _keys(self) -> List[str]:
         if self._host is not None:
             base = list(self._host)
-        elif self._reduce == "mean":
+        elif self._reduce == "mean" and not isinstance(self._device,
+                                                       dict):
             base = list(self._device[0]) if self._device else []
         else:
             base = list(self._device or {})

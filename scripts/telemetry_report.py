@@ -104,15 +104,34 @@ def _overlap_section(intervals: List[tuple]) -> List[str]:
     at span exit). Makes pipelining claims checkable from any run's
     JSONL: wall covered by >= 1 span, by >= 2 CONCURRENT spans (real
     overlap, e.g. train.update_device under train.collect), and the
-    largest uncovered gaps (loop time no phase span accounts for)."""
+    largest uncovered gaps (loop time no phase span accounts for).
+
+    Fused epochs (``train.fused_epoch``, rl/fused.py) are ONE span per
+    epoch whose collect/update rounds overlap INSIDE the compiled
+    program, invisible to span accounting — counting them with the
+    collect/update pairs would read a fused run as 0% overlap. They are
+    split out and labelled; the overlap math runs over the remaining
+    host-visible phase spans."""
     from ddls_tpu.telemetry import overlap_summary
 
     train = [iv for iv in intervals if iv[0].startswith("train.")]
+    fused = [iv for iv in train if iv[0] == "train.fused_epoch"]
+    train = [iv for iv in train if iv[0] != "train.fused_epoch"]
+    fused_lines = []
+    if fused:
+        fused_total = sum(t1 - t0 for _, t0, t1 in fused)
+        fused_lines = [
+            "== fused epochs (train.fused_epoch: collect+update rounds "
+            "overlap IN-PROGRAM; excluded from span-overlap accounting) "
+            "==",
+            f"{'fused_epochs':<28}{len(fused):>10}",
+            f"{'fused_epoch_total_s':<28}{fused_total:>10.3f}", ""]
     ov = overlap_summary(train)
     if not ov.get("n_spans"):
-        return []
+        return fused_lines
     window_t0 = min(t0 for _, t0, _ in train)
-    lines = ["== overlap (train.* spans, intervals from ts - dur_s) ==",
+    lines = fused_lines + [
+             "== overlap (train.* spans, intervals from ts - dur_s) ==",
              f"{'spans':<28}{ov['n_spans']:>10}",
              f"{'window_s':<28}{ov['window_s']:>10.3f}",
              f"{'covered_by_>=1_span_s':<28}{ov['covered_1_s']:>10.3f}",

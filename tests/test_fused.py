@@ -1,0 +1,525 @@
+"""Fused on-device collect→update epochs (rl/fused.py, ISSUE 12).
+
+The load-bearing pin is the x64 full-epoch parity driver: the fused
+program (ONE jitted lax.scan over U collect→update rounds) must
+reproduce the sequential device-collector path — `DevicePPOCollector`
+collects, `PPOLearner.train_step` updates — EXACTLY: post-training
+params bit-equal, per-update metrics equal, episode records equal, on
+the virtual 8-device mesh with lanes sharded over dp. Same subprocess
+isolation as tests/test_jax_episode.py (JAX_ENABLE_X64 is
+process-global).
+
+In-process (f32): the steady-state fused epoch is transfer-free under
+``jax.transfer_guard("disallow")``; DQN/ES reject loop_mode='fused'
+loudly before any env construction; the autotuner units (candidate
+ranking, size model, cache, probe fallback) and the chip lock run
+device-free.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+ENV_CLS = "ddls_tpu.envs.partitioning_env.RampJobPartitioningEnvironment"
+
+_TINY_MODEL = {"fcnet_hiddens": [16],
+               "custom_model_config": {"out_features_msg": 4,
+                                       "out_features_hidden": 8,
+                                       "out_features_node": 4,
+                                       "out_features_graph": 4}}
+
+
+def _env_config(dataset_dir, horizon=2e3):
+    return dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2, "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 60.0},
+            "max_acceptable_job_completion_time_frac_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Uniform",
+                "min_val": 0.2, "max_val": 1.0, "decimals": 2},
+            "replication_factor": 10,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 10},
+        max_partitions_per_op=4, min_op_run_time_quantum=0.01,
+        reward_function="job_acceptance", max_simulation_run_time=horizon,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+
+
+def _make_fused_loop(dataset_dir, **kw):
+    from ddls_tpu.train import make_epoch_loop
+
+    defaults = dict(
+        path_to_env_cls=ENV_CLS,
+        env_config=_env_config(dataset_dir),
+        model=_TINY_MODEL,
+        algo_config={"train_batch_size": 16, "sgd_minibatch_size": 8,
+                     "num_sgd_iter": 2, "num_workers": 8},
+        num_envs=8, rollout_length=2, n_devices=8,
+        use_parallel_envs=False, evaluation_interval=None, seed=0,
+        loop_mode="fused", updates_per_epoch=2,
+        fused_config={"lanes": 8, "segment_len": 2})
+    defaults.update(kw)
+    return make_epoch_loop("ppo", **defaults)
+
+
+# ===================================================== x64 parity driver
+# A fused loop of E epochs x U updates must equal U*E sequential
+# device-collector epochs: params EXACTLY, per-update metrics (the
+# LazyMetrics mean over each fused epoch equals the f64 mean of its
+# sequential epochs' metrics), and episode records field-for-field —
+# with episodes actually completing (the 6e2 horizon ends one per lane).
+PARITY_DRIVER = r"""
+import tempfile
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+assert jax.config.read("jax_enable_x64")
+assert len(jax.devices()) == 8
+from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+from ddls_tpu.train import make_epoch_loop
+
+import test_fused as tf
+
+d = tempfile.mkdtemp(prefix="fused_parity_")
+generate_pipedream_txt_files(d, n_cnn=1, n_translation=1, seed=9)
+algo = {"train_batch_size": 16, "sgd_minibatch_size": 8,
+        "num_sgd_iter": 2, "num_workers": 8, "device_collector": True}
+kw = dict(path_to_env_cls=tf.ENV_CLS,
+          env_config=tf._env_config(d, horizon=6e2),
+          model=tf._TINY_MODEL,
+          num_envs=8, rollout_length=2, n_devices=8,
+          use_parallel_envs=False, evaluation_interval=None, seed=0)
+
+U, E = 2, 3
+seq = make_epoch_loop("ppo", algo_config=dict(algo),
+                      loop_mode="sequential", **kw)
+seq_metrics, seq_episodes = [], []
+for _ in range(U * E):
+    r = seq.run()
+    seq_metrics.append(dict(r["learner"]))
+    seq_episodes.extend(r["episodes"])
+seq_params = jax.device_get(seq.state.params)
+seq.close()
+
+fus = make_epoch_loop("ppo", algo_config=dict(algo), loop_mode="fused",
+                      updates_per_epoch=U, metrics_sync_interval=1,
+                      fused_config={"lanes": 8, "segment_len": 2}, **kw)
+fus_means, fus_episodes = [], []
+for _ in range(E):
+    r = fus.run()
+    assert r["learner"]["num_updates"] == U
+    fus_means.append(dict(r["learner"]))
+    fus_episodes.extend(r["episodes"])
+fus_params = jax.device_get(fus.state.params)
+fus.close()
+
+# post-training params: EXACT (bitwise array equality)
+jax.tree_util.tree_map(
+    lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+    seq_params, fus_params)
+
+# LazyMetrics values: each fused epoch's mean equals the f64 mean of
+# its U sequential updates' (already-float) metrics
+for e in range(E):
+    want = {k: float(np.mean([seq_metrics[e * U + u][k]
+                              for u in range(U)]))
+            for k in seq_metrics[0]}
+    got = {k: v for k, v in fus_means[e].items() if k in want}
+    assert got == want, (e, got, want)
+
+# episode records: same records, same order, same fields — and
+# episodes genuinely completed (the horizon guarantees >= 1 per lane)
+assert len(seq_episodes) >= 8, len(seq_episodes)
+assert seq_episodes == fus_episodes
+print(f"FUSED_PARITY_OK episodes={len(fus_episodes)}")
+"""
+
+
+def test_fused_full_epoch_parity_x64():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_ENABLE_X64"] = "1"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO, os.path.dirname(os.path.abspath(__file__))])
+    res = subprocess.run([sys.executable, "-c", PARITY_DRIVER], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, (res.stdout[-4000:], res.stderr[-4000:])
+    assert "FUSED_PARITY_OK" in res.stdout, res.stdout[-2000:]
+
+
+# =================================================== steady-state guards
+@pytest.fixture(scope="module")
+def fused_dataset(tmp_path_factory):
+    from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+
+    d = str(tmp_path_factory.mktemp("fused_jobs"))
+    generate_pipedream_txt_files(d, n_cnn=1, n_translation=1, seed=9)
+    return d
+
+
+def test_fused_epoch_transfer_free_then_harvests(fused_dataset):
+    """ISSUE 12 acceptance, one loop/compile for both halves: with the
+    drain boundary at metrics_sync_interval=3, epoch 2 is a
+    steady-state fused epoch performing NO implicit device<->host
+    transfer (params, opt state, rng keys, metrics, and episode
+    counters all stay on device), and epoch 3 hits the drain boundary —
+    params moved, metrics are epoch-mean-shaped, and episode records
+    surface with the host record schema."""
+    import jax
+
+    loop = _make_fused_loop(
+        fused_dataset, metrics_sync_interval=3,
+        env_config=_env_config(fused_dataset, horizon=6e2))
+    try:
+        before = jax.device_get(loop.state.params)
+        r1 = loop.run()  # warm: compile + first-use constant transfers
+        assert r1["episodes"] == []  # epoch 1: no drain boundary yet
+        with jax.transfer_guard("disallow"):
+            r2 = loop.run()
+        assert r2["episodes"] == []  # still pending on device
+        r3 = loop.run()  # epoch 3: the drain boundary
+        for r in (r1, r2, r3):
+            assert np.isfinite(r["learner"]["total_loss"])
+            assert r["learner"]["num_updates"] == 2
+            assert r["env_steps_this_iter"] == 2 * 2 * 8  # U * T * B
+        assert loop.autotune_result.source == "explicit"
+        assert (loop.autotune_result.lanes,
+                loop.autotune_result.segment_len) == (8, 2)
+        moved = jax.tree_util.tree_map(
+            lambda a, b: float(np.abs(np.asarray(a)
+                                      - np.asarray(b)).max()),
+            before, jax.device_get(loop.state.params))
+        assert max(jax.tree_util.tree_leaves(moved)) > 0
+        episodes = r3["episodes"]
+        assert episodes, "horizon 6e2 must complete episodes by epoch 3"
+        for e in episodes:
+            assert set(e) >= {"env_index", "episode_return",
+                              "episode_length", "num_jobs_arrived",
+                              "num_jobs_completed", "num_jobs_blocked",
+                              "acceptance_rate", "blocking_rate"}
+            assert (e["num_jobs_arrived"]
+                    >= e["num_jobs_completed"] + e["num_jobs_blocked"])
+    finally:
+        loop.close()
+
+
+# ====================================================== loud rejections
+@pytest.mark.parametrize("algo", ["apex_dqn", "es"])
+def test_fused_rejected_loudly_without_contract(algo):
+    """DQN (host replay insertion) and ES (host population fitness)
+    cannot run a fused in-kernel epoch; the rejection fires before any
+    env/model construction (env_config={} would explode otherwise)."""
+    from ddls_tpu.train import make_epoch_loop
+
+    with pytest.raises(ValueError, match="fused"):
+        make_epoch_loop(algo, path_to_env_cls=ENV_CLS, env_config={},
+                        loop_mode="fused")
+
+
+def test_fused_rejects_multiprocess_and_bad_mode():
+    from ddls_tpu.train import make_epoch_loop
+
+    with pytest.raises(ValueError, match="loop_mode"):
+        make_epoch_loop("ppo", path_to_env_cls=ENV_CLS, env_config={},
+                        loop_mode="bogus")
+
+
+# ====================================================== autotuner units
+def test_candidate_configs_rank_and_divide():
+    from ddls_tpu.rl.fused import candidate_configs
+
+    # dp=1: every divisor of the batch up to max_lanes, fewest first
+    assert candidate_configs(64, 1, 8) == [(1, 64), (2, 32), (4, 16),
+                                           (8, 8)]
+    # dp=4: lanes must divide over the dp axis
+    assert candidate_configs(64, 4, 16) == [(4, 16), (8, 8), (16, 4)]
+    # lanes never exceed the requested num_envs
+    assert candidate_configs(64, 4, 4) == [(4, 16)]
+
+
+def test_estimate_monotonic_in_lanes_flat_in_segment():
+    from ddls_tpu.rl.fused import estimate_program_bytes
+
+    cells = 10_000
+    assert (estimate_program_bytes(1, 64, cells)
+            < estimate_program_bytes(8, 8, cells)
+            < estimate_program_bytes(64, 1, cells))
+    # a lax.scan's program does not grow with its length
+    assert (estimate_program_bytes(4, 16, cells)
+            == estimate_program_bytes(4, 1024, cells))
+    # captured table constants count
+    assert (estimate_program_bytes(4, 16, cells)
+            < estimate_program_bytes(4, 16, cells * 10))
+
+
+def test_autotune_cache_roundtrip(tmp_path):
+    from ddls_tpu.rl.fused import (load_cached_config,
+                                   store_cached_config)
+
+    probe_dir = str(tmp_path / "probe")
+    assert load_cached_config(probe_dir, "k") is None
+    store_cached_config(probe_dir, "k", {"lanes": 2, "segment_len": 8,
+                                         "estimated_bytes": 123,
+                                         "actual_bytes": 456})
+    got = load_cached_config(probe_dir, "k")
+    assert got == {"lanes": 2, "segment_len": 8,
+                   "estimated_bytes": 123, "actual_bytes": 456}
+    # corrupt cache reads as a miss, never an error
+    with open(os.path.join(probe_dir, "fused_autotune.json"), "w") as f:
+        f.write("not json")
+    assert load_cached_config(probe_dir, "k") is None
+
+
+class _EtStub:
+    def __init__(self):
+        from ddls_tpu.sim.jax_env import ConfigPads
+
+        self.pads = ConfigPads(n_ops=4, n_deps=4, n_fwd=2, n_parents=1,
+                               max_split=2, n_groups=1, group_edges=1,
+                               n_sync=1, n_o2o=1)
+        self.n_srv = 8
+        self.n_chan = 1
+        self.types = ["a"]
+        self.degrees = [1, 2]
+        self.max_action = 2
+        self.tables = {"t": np.zeros((4, 4))}
+
+
+class _FailingDriver:
+    def lower(self, state):
+        raise RuntimeError("remote_compile rejected the program")
+
+
+def test_autotune_fallback_when_nothing_compiles(tmp_path):
+    """Every candidate failing to compile returns (None, result) so the
+    caller can fall back to loop_mode='pipelined' loudly; every probed
+    config and its error ride the result."""
+    from ddls_tpu.rl.fused import autotune_fused
+
+    driver, result = autotune_fused(
+        lambda lanes, seg: _FailingDriver(), state=None, et=_EtStub(),
+        total_steps=8, updates_per_epoch=1, dp=1, max_lanes=2,
+        probe_dir=str(tmp_path), probe_timeout_s=5.0)
+    assert driver is None
+    assert result.source == "failed"
+    assert [(l, s) for l, s, _, _ in result.probed] == [(1, 8), (2, 4)]
+    assert all(not ok for _, _, ok, _ in result.probed)
+    assert all("remote_compile" in err for _, _, _, err in result.probed)
+    # nothing cached on failure
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "fused_autotune.json"))
+
+
+def test_autotune_explicit_config_validation(tmp_path):
+    from ddls_tpu.rl.fused import autotune_fused
+
+    with pytest.raises(ValueError, match="both lanes and segment_len"):
+        autotune_fused(lambda l, s: None, None, _EtStub(), 8, 1, 1, 2,
+                       probe_dir=str(tmp_path), lanes=2)
+    with pytest.raises(ValueError, match="must equal the per-update"):
+        autotune_fused(lambda l, s: None, None, _EtStub(), 8, 1, 1, 2,
+                       probe_dir=str(tmp_path), lanes=2, segment_len=2)
+
+
+def test_autotune_cache_hit_skips_probing(tmp_path):
+    """The fused-vs-fallback gate is a pure function of the cached
+    config (multihost rule): a cache hit builds the cached config and
+    never probe-compiles."""
+    from ddls_tpu.rl.fused import (autotune_fused, store_cached_config,
+                                   workload_signature)
+
+    et = _EtStub()
+    key = workload_signature(et, 8, 1, 1, max_lanes=8, extra="x")
+    store_cached_config(str(tmp_path), key,
+                        {"lanes": 2, "segment_len": 4,
+                         "estimated_bytes": 7, "actual_bytes": 9})
+    built = []
+    driver, result = autotune_fused(
+        lambda lanes, seg: built.append((lanes, seg)) or "driver",
+        state=None, et=et, total_steps=8, updates_per_epoch=1, dp=1,
+        max_lanes=8, probe_dir=str(tmp_path), signature_extra="x")
+    assert driver == "driver"
+    assert built == [(2, 4)]
+    assert result.source == "cache"
+    assert (result.lanes, result.segment_len) == (2, 4)
+    assert result.actual_bytes == 9 and result.probed == []
+
+
+def test_workload_signature_keys_everything(tmp_path):
+    from ddls_tpu.rl.fused import workload_signature
+
+    et = _EtStub()
+    base = workload_signature(et, 8, 1, 1)
+    assert workload_signature(et, 8, 1, 1) == base
+    assert workload_signature(et, 16, 1, 1) != base  # batch
+    assert workload_signature(et, 8, 2, 1) != base   # updates/epoch
+    assert workload_signature(et, 8, 1, 2) != base   # mesh width
+    # the lane cap keys too: a cached config can never carry more
+    # lanes than the current run's num_envs allows
+    assert workload_signature(et, 8, 1, 1, max_lanes=4) != base
+    assert workload_signature(et, 8, 1, 1, extra="m") != base
+
+
+# ========================================================== chip lock
+def test_chip_lock_acquire_release(tmp_path, monkeypatch):
+    from ddls_tpu.rl.fused import LOCK_OWNER_ENV, chip_lock
+
+    monkeypatch.delenv(LOCK_OWNER_ENV, raising=False)
+    probe_dir = str(tmp_path / "probe")
+    lock_path = os.path.join(probe_dir, "tpu.lock")
+    with chip_lock(probe_dir) as lock:
+        assert lock.acquired
+        assert os.path.exists(lock_path)
+        assert os.environ.get(LOCK_OWNER_ENV) == "1"
+        with open(lock_path) as f:
+            assert int(f.read().strip()) == os.getpid()
+    assert not os.path.exists(lock_path)
+    assert LOCK_OWNER_ENV not in os.environ
+
+
+def test_chip_lock_never_steals_foreign_lock(tmp_path, monkeypatch):
+    from ddls_tpu.rl.fused import LOCK_OWNER_ENV, chip_lock
+
+    monkeypatch.delenv(LOCK_OWNER_ENV, raising=False)
+    probe_dir = str(tmp_path / "probe")
+    os.makedirs(probe_dir)
+    lock_path = os.path.join(probe_dir, "tpu.lock")
+    live = os.getppid() or 1  # a provably LIVE foreign owner
+    with open(lock_path, "w") as f:
+        f.write(f"{live}\n")
+    with chip_lock(probe_dir) as lock:
+        assert not lock.acquired
+        assert LOCK_OWNER_ENV not in os.environ  # our probes defer
+    assert os.path.exists(lock_path)  # never removed a live foreign lock
+    with open(lock_path) as f:
+        assert f.read() == f"{live}\n"
+
+
+def test_chip_lock_reclaims_stale_dead_pid_lock(tmp_path, monkeypatch):
+    """Crash fallback: a lock whose recorded owner pid is provably dead
+    (a SIGKILLed run cannot unlink its own file) is reclaimed instead of
+    diverting every later run's probes to CPU forever; bench's probe
+    cache ignores the same stale locks."""
+    import bench
+
+    from ddls_tpu.rl.fused import LOCK_OWNER_ENV, chip_lock, lock_is_stale
+
+    monkeypatch.delenv(LOCK_OWNER_ENV, raising=False)
+    probe_dir = str(tmp_path / "probe")
+    os.makedirs(probe_dir)
+    lock_path = os.path.join(probe_dir, "tpu.lock")
+    # find a pid that provably does not exist
+    dead = 2 ** 22 - 3
+    while os.path.exists(f"/proc/{dead}"):
+        dead -= 1
+    with open(lock_path, "w") as f:
+        f.write(f"{dead}\n")
+    assert lock_is_stale(lock_path)
+    err, reason = bench.consult_probe_state(probe_dir=probe_dir)
+    assert reason != "tpu_lock_held"  # stale lock never diverts probes
+    with chip_lock(probe_dir) as lock:
+        assert lock.acquired  # reclaimed
+        with open(lock_path) as f:
+            assert int(f.read().strip()) == os.getpid()
+    assert not os.path.exists(lock_path)
+    # an empty/pid-less lock (external wrapper) stays respected
+    with open(lock_path, "w"):
+        pass
+    assert not lock_is_stale(lock_path)
+    err, reason = bench.consult_probe_state(probe_dir=probe_dir)
+    assert reason == "tpu_lock_held"
+
+
+def test_chip_lock_delegated_ownership_under_wrapper(tmp_path,
+                                                     monkeypatch):
+    """A wrapper above this process that holds the lock and exports
+    DDLS_TPU_LOCK_OWNER=1 (the documented convention) delegates chip
+    ownership: entry does no file ops, `owned` is True (fused keeps
+    running instead of downgrading to pipelined), and exit leaves the
+    wrapper's lock alone."""
+    from ddls_tpu.rl.fused import LOCK_OWNER_ENV, chip_lock
+
+    probe_dir = str(tmp_path / "probe")
+    os.makedirs(probe_dir)
+    lock_path = os.path.join(probe_dir, "tpu.lock")
+    with open(lock_path, "w") as f:
+        f.write(f"{os.getppid() or 1}\n")  # the wrapper's live lock
+    monkeypatch.setenv(LOCK_OWNER_ENV, "1")
+    with chip_lock(probe_dir) as lock:
+        assert lock.delegated and not lock.acquired
+        assert lock.owned
+    assert os.path.exists(lock_path)  # the wrapper's lock untouched
+    assert os.environ.get(LOCK_OWNER_ENV) == "1"
+
+
+def test_autotune_cache_rejects_tampered_entries(tmp_path):
+    """A cached config must satisfy every constraint the prober
+    enforces — lane cap, exact batch factorisation, dp divisibility —
+    or it is re-probed, never obeyed."""
+    from ddls_tpu.rl.fused import (autotune_fused, store_cached_config,
+                                   workload_signature)
+
+    et = _EtStub()
+    key = workload_signature(et, 8, 1, 1, max_lanes=8, extra="x")
+    # segment_len tampered: lanes * segment_len != total_steps
+    store_cached_config(str(tmp_path), key,
+                        {"lanes": 2, "segment_len": 8,
+                         "estimated_bytes": 7, "actual_bytes": 9})
+    driver, result = autotune_fused(
+        lambda lanes, seg: _FailingDriver(), state=None, et=et,
+        total_steps=8, updates_per_epoch=1, dp=1, max_lanes=8,
+        probe_dir=str(tmp_path), probe_timeout_s=5.0,
+        signature_extra="x")
+    # the tampered entry was ignored and probing ran (and failed here)
+    assert result.source == "failed"
+    assert len(result.probed) >= 1
+
+
+def test_bench_lock_owner_env_matches_probe_cache():
+    # the handshake bench.py's consult_probe_state keys on — a rename on
+    # either side would silently divert an owner's probes to CPU
+    import bench
+
+    from ddls_tpu.rl.fused import LOCK_OWNER_ENV
+
+    assert bench.PROBE_LOCK_OWNER_ENV == LOCK_OWNER_ENV
+
+
+# ================================================= LazyMetrics (fused)
+def test_lazy_metrics_stacked_dict_mean():
+    """The fused epoch shape: one dict of [U]-stacked device arrays,
+    reduced as the f64 mean per key (bit-matching the sequential loop's
+    python-float mean over its per-update dicts)."""
+    import jax.numpy as jnp
+
+    from ddls_tpu.train.metrics import LazyMetrics
+
+    vals = np.asarray([0.1, 0.2, 0.7], np.float32)
+    lm = LazyMetrics({"loss": jnp.asarray(vals)}, reduce="mean",
+                     extras={"num_updates": 3})
+    assert lm.pending
+    assert set(lm) == {"loss", "num_updates"}
+    want = float(np.mean([float(v) for v in vals]))
+    assert lm["loss"] == want
+    assert lm["num_updates"] == 3.0
+    assert not lm.pending

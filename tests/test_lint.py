@@ -306,6 +306,27 @@ def test_hot_path_transfer_stale_qualname_allowance_is_error(tmp_path):
                for f in errors_of(res, "hot-path-transfer"))
 
 
+def test_hot_path_transfer_fused_driver_shape(tmp_path):
+    """PR 8: the fused driver's epoch body must stay coercion-free
+    while its drain-boundary harvest (already-fetched numpy) is
+    config-allowlisted — the exact pyproject shape rl/fused.py ships
+    with."""
+    src = ("import numpy as np\n"
+           "class FusedEpochDriver:\n"
+           "    def fused_epoch(self, state, rngs):\n"
+           "        return float(state.step)\n"
+           "    def harvest_episodes(self, ep):\n"
+           "        return [int(x) for x in np.asarray(ep['done'])]\n")
+    cfg = {"hot-path-transfer": {
+        "allow": {"fused.py::FusedEpochDriver.harvest_episodes":
+                  "records from the already-fetched host trace"}}}
+    res = lint_tree(tmp_path, {"fused.py": src}, "hot-path-transfer",
+                    cfg)
+    msgs = [f.message for f in errors_of(res, "hot-path-transfer")]
+    assert len(msgs) == 1
+    assert "float(...)" in msgs[0] and "fused_epoch" in msgs[0]
+
+
 # ------------------------------------------- multihost-deterministic-gates
 GATE_BAD = ("import time\n"
             "def run(self, learner, x):\n"
@@ -371,6 +392,63 @@ def test_multihost_gates_dict_update_is_not_a_collective(tmp_path):
                     "multihost-deterministic-gates")
     (f,) = errors_of(res, "multihost-deterministic-gates")
     assert f.line == 6 and "update" in f.message
+
+
+def test_multihost_gates_covers_fused_epoch_calls(tmp_path):
+    """PR 8 coverage: the fused epoch dispatch (rl/fused.py) is a
+    guarded call — a nondeterministic gate around it is the same
+    desynced-collective hang as one around train_step."""
+    src = ("import time\n"
+           "def run(self, state, rngs):\n"
+           "    if time.time() > self.deadline:\n"
+           "        self.fused.fused_epoch(state, rngs)\n")
+    res = lint_tree(tmp_path, {"fused.py": src},
+                    "multihost-deterministic-gates")
+    (f,) = errors_of(res, "multihost-deterministic-gates")
+    assert f.line == 4 and "fused_epoch" in f.message
+
+
+def test_multihost_gates_fused_cached_config_gate_clean(tmp_path):
+    # the autotuner fallback contract: the fused-vs-pipelined gate is a
+    # pure function of the CACHED config (+ epoch counters) — that
+    # shape must lint clean
+    src = ("def run(self, state, rngs):\n"
+           "    if self.autotune_result.source != 'failed':\n"
+           "        self.fused.fused_epoch(state, rngs)\n"
+           "    if self.epoch_counter % self.sync_interval == 0:\n"
+           "        self.fused.fused_epoch(state, rngs)\n")
+    res = lint_tree(tmp_path, {"fused.py": src},
+                    "multihost-deterministic-gates")
+    assert res.errors == []
+
+
+def test_multihost_gates_fused_epoch_suppressed(tmp_path):
+    src = ("import os\n"
+           "def run(self, state, rngs):\n"
+           "    if os.environ.get('FORCE_FUSED'):\n"
+           "        self.fused.fused_epoch(state, rngs)  # ddls-lint: "
+           "allow(multihost-deterministic-gates) -- single-process "
+           "debug hook, fused rejects multi-host at build\n")
+    res = lint_tree(tmp_path, {"fused.py": src},
+                    "multihost-deterministic-gates")
+    assert res.errors == []
+    assert any(f.suppressed for f in res.findings)
+
+
+def test_rules_scope_covers_fused_driver():
+    """The PR 8 scope extension itself: rl/fused.py is on the
+    hot-path-transfer module list AND inside the multihost rule's
+    scan scope (train/ alone no longer bounds the collective surface)."""
+    from ddls_tpu.lint.rules.hot_path_transfer import DEFAULT_MODULES
+    from ddls_tpu.lint.rules.multihost_gates import (
+        DEFAULT_GUARDED_CALLS, MultihostGatesRule)
+
+    assert "ddls_tpu/rl/fused.py" in DEFAULT_MODULES
+    assert "fused_epoch" in DEFAULT_GUARDED_CALLS
+    rule = MultihostGatesRule()
+    assert rule.in_scope("ddls_tpu/rl/fused.py")
+    assert rule.in_scope("ddls_tpu/train/loops.py")
+    assert not rule.in_scope("ddls_tpu/rl/ppo.py")
 
 
 def test_multihost_gates_suppressed(tmp_path):
