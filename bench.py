@@ -705,8 +705,19 @@ def run_jaxenv_bench(args) -> dict:
     with telemetry.span("bench.run") as run_span:
         out = jax.block_until_ready(episode_fn(mk_bank(1), actions))
     n_dec = int(np.asarray(out["trace"][5]).sum())
+    # in-kernel lookahead memo counters of the timed episode (the
+    # single-lane kernel runs the memo by default — ISSUE 13); drained
+    # here with the rest of the episode outputs, never per step
+    memo_h = int(np.asarray(out["memo_hits"]))
+    memo_m = int(np.asarray(out["memo_misses"]))
+    memo_e = int(np.asarray(out["memo_evicts"]))
 
-    vfn = jax.jit(jax.vmap(episode_fn, in_axes=(0, 0)))
+    # memo off for the vmapped lanes: under vmap the probe's lax.cond
+    # lowers to select and computes both branches — correct but inert
+    # (sim/jax_memo.py), so the 8-lane aggregate measures the plain
+    # kernel rather than paying dead probe overhead
+    vfn = jax.jit(jax.vmap(make_episode_fn(et, memo_cfg=None),
+                           in_axes=(0, 0)))
     banks = [mk_bank(s) for s in range(8)]
     bb = {k: jnp.stack([b[k] for b in banks]) for k in banks[0]}
     aa = jnp.broadcast_to(actions, (8, D))
@@ -727,6 +738,9 @@ def run_jaxenv_bench(args) -> dict:
         "vmap8_decisions_per_sec": round(vdec / vmap_span.duration_s, 2),
         "max_degree": args.jaxenv_max_degree,
         "pads": {"ops": et.pads.n_ops, "deps": et.pads.n_deps},
+        "memo": {"hits": memo_h, "misses": memo_m, "evicts": memo_e,
+                 "hit_rate": round(memo_h / (memo_h + memo_m), 4)
+                 if memo_h + memo_m else 0.0},
         "telemetry": telemetry.snapshot(),
     }
 
@@ -1494,6 +1508,15 @@ def run_bench(args, platform_note: str | None,
             mode_results[mode]["updates_per_epoch"] = \
                 args.fused_updates_per_epoch
             mode_results[mode]["autotune"] = fused_autotune.as_dict()
+        if mode == "fused" and fused_driver is not None:
+            # ISSUE-13 artifact field: the in-kernel lookahead memo's
+            # cumulative hit/miss/evict counts + hit rate — ONE fetch
+            # here at the reporting boundary (counters ride the carried
+            # device state; None when lanes > 1 left the memo off)
+            memo = fused_driver.memo_counters()
+            if memo is not None:
+                memo["hit_rate"] = round(memo["hit_rate"], 4)
+                mode_results[mode]["memo"] = memo
 
     vec.close()
     if headline_mode not in mode_results:

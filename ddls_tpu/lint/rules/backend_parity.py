@@ -21,6 +21,14 @@ run):
    collector emits must be a key the host's ``harvest_episode_record``
    (``rl/rollout.py``) knows — device- and host-collected records must
    stay interchangeable.
+4. The in-kernel lookahead memo's key surface (``sim/jax_memo.py``,
+   ISSUE 13): every host key builder the memo declares it mirrors
+   (``HOST_KEY_SURFACE``) must still exist as a function in
+   ``sim/cluster.py`` — a host key-builder rename fails here, not at
+   the first stale-memo debugging session — and every memo counter key
+   (``MEMO_TRACE_KEYS``) must be traced by ``make_segment_fn``, so the
+   counters drain with the episode counters rather than silently
+   vanishing from the compact trace.
 """
 from __future__ import annotations
 
@@ -34,6 +42,7 @@ DEFAULT_PATHS = {
     "jax_env": "ddls_tpu/sim/jax_env.py",
     "ppo_device": "ddls_tpu/rl/ppo_device.py",
     "rollout": "ddls_tpu/rl/rollout.py",
+    "jax_memo": "ddls_tpu/sim/jax_memo.py",
     "host_cause_files": ["ddls_tpu/sim/cluster.py",
                          "ddls_tpu/sim/actions.py"],
 }
@@ -109,11 +118,13 @@ class BackendSurfaceParityRule(Rule):
         jax_env = _get_sf(ctx, str(paths["jax_env"]))
         ppo_device = _get_sf(ctx, str(paths["ppo_device"]))
         rollout = _get_sf(ctx, str(paths["rollout"]))
+        jax_memo = _get_sf(ctx, str(paths["jax_memo"]))
         host_files = [_get_sf(ctx, str(p))
                       for p in paths["host_cause_files"]]
         for rel, sf in ([(paths["jax_env"], jax_env),
                          (paths["ppo_device"], ppo_device),
-                         (paths["rollout"], rollout)]
+                         (paths["rollout"], rollout),
+                         (paths["jax_memo"], jax_memo)]
                         + list(zip(paths["host_cause_files"],
                                    host_files))):
             if sf is None or sf.tree is None:
@@ -134,6 +145,11 @@ class BackendSurfaceParityRule(Rule):
                 jax_env, list(host_files), jitted_only))
         findings.extend(self._check_episode_fields(
             jax_env, ppo_device, rollout))
+        if (jax_memo is not None and jax_memo.tree is not None
+                and host_files and host_files[0] is not None
+                and host_files[0].tree is not None):
+            findings.extend(self._check_memo_surface(
+                jax_memo, host_files[0], jax_env))
         return findings
 
     # --------------------------------------------------------- cause codes
@@ -210,6 +226,68 @@ class BackendSurfaceParityRule(Rule):
                     f"jitted cause string {cause!r} does not exist on "
                     "the host side (sim/cluster.py / sim/actions.py) — "
                     "host and jitted cause vocabularies drifted"))
+        return findings
+
+    # --------------------------------------------------- memo key surface
+    def _check_memo_surface(self, jax_memo: SourceFile,
+                            cluster: SourceFile,
+                            jax_env: SourceFile) -> List[Finding]:
+        """The in-kernel memo key contract (sim/jax_memo.py): the host
+        key builders it declares in ``HOST_KEY_SURFACE`` must still be
+        functions in sim/cluster.py, and its ``MEMO_TRACE_KEYS`` must be
+        traced by ``make_segment_fn`` so they drain with the episode
+        counters."""
+        findings: List[Finding] = []
+        tables: Dict[str, List[str]] = {}
+        lines: Dict[str, int] = {}
+        for node in jax_memo.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if (isinstance(target, ast.Name)
+                    and target.id in ("HOST_KEY_SURFACE",
+                                      "MEMO_TRACE_KEYS")
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                vals = [e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)]
+                tables[target.id] = vals
+                lines[target.id] = node.lineno
+        for name in ("HOST_KEY_SURFACE", "MEMO_TRACE_KEYS"):
+            if name not in tables:
+                findings.append(Finding(
+                    self.id, jax_memo.rel, 1,
+                    f"could not locate the {name} tuple — the in-kernel "
+                    "memo key surface moved; update "
+                    "backend-surface-parity"))
+        host_fns = {node.name for node in ast.walk(cluster.tree)
+                    if isinstance(node, ast.FunctionDef)}
+        for fn in tables.get("HOST_KEY_SURFACE", ()):
+            if fn not in host_fns:
+                findings.append(Finding(
+                    self.id, jax_memo.rel,
+                    lines.get("HOST_KEY_SURFACE", 1),
+                    f"memo HOST_KEY_SURFACE names {fn!r} but no such "
+                    f"function exists in {cluster.rel} — the host memo-"
+                    "key builders moved without the in-kernel mirror"))
+        # the segment kernel emits the counters through
+        # memo_trace_counters (ONE naming home), so the traced
+        # vocabulary is make_segment_fn's literals plus that helper's
+        segment_fn = _function(jax_env.tree, "make_segment_fn")
+        traced = (_str_constants(segment_fn)
+                  if segment_fn is not None else set())
+        emitter = _function(jax_memo.tree, "memo_trace_counters")
+        if emitter is not None:
+            traced |= _str_constants(emitter)
+        for key in tables.get("MEMO_TRACE_KEYS", ()):
+            if key not in traced:
+                findings.append(Finding(
+                    self.id, jax_memo.rel,
+                    lines.get("MEMO_TRACE_KEYS", 1),
+                    f"memo counter key {key!r} is not traced by "
+                    "make_segment_fn (nor emitted by "
+                    "memo_trace_counters) — memo counters would not "
+                    "drain with the episode counters"))
         return findings
 
     # ----------------------------------------------------- episode fields

@@ -37,6 +37,10 @@ DEFAULT_MODULES = (
     "ddls_tpu/rl/ppo_device.py",
     "ddls_tpu/rl/shm.py",
     "ddls_tpu/rl/fused.py",
+    # the in-kernel lookahead memo rides the carried device state of
+    # every collect; an implicit coercion here would fetch the table (or
+    # its counters) EVERY decision step
+    "ddls_tpu/sim/jax_memo.py",
 )
 
 _IMPLICIT_COERCIONS = {"np.asarray", "numpy.asarray"}

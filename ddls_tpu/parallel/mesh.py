@@ -145,3 +145,39 @@ def shard_batch(mesh: Mesh, tree, batch_axis: int = 0,
         return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(put, tree)
+
+
+def place_state_tree(tree, shardings):
+    """Place a process-identical host pytree (train state) onto its
+    shardings — the multi-host-safe ``device_put``.
+
+    Single-process this IS ``jax.device_put`` (same aliasing/donation
+    semantics, bit-identical path). Multi-process, ``device_put`` onto a
+    non-fully-addressable sharding routes every host/uncommitted leaf
+    through ``multihost_utils.assert_equal``, which broadcasts the WHOLE
+    value per leaf — a per-leaf collective stream that current jax/gloo
+    can collide with neighbouring collectives under process skew
+    (measured on the 1-core CPU box: ``gloo ... op.preamble.length <=
+    op.nbytes`` aborts in the distributed workers' ``init_state``). The
+    framework's multi-host rules already guarantee the state is
+    IDENTICAL on every process by construction (deterministic seeds —
+    CLAUDE.md), so the check is redundant: each process contributes its
+    local copy through ``jax.make_array_from_process_local_data``
+    exactly like :func:`shard_batch`, collective-free. The logical
+    (global) shape of every leaf equals its local shape — replicated
+    leaves are whole copies, and tensor-parallel leaves
+    (``mp_tree_shardings``) have each process slice ITS shards out of
+    its full local copy.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+    from jax.sharding import Sharding
+
+    if isinstance(shardings, Sharding):
+        shardings = jax.tree_util.tree_map(lambda _: shardings, tree)
+
+    def put(x, s):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(s, x, x.shape)
+
+    return jax.tree_util.tree_map(put, tree, shardings)

@@ -36,7 +36,8 @@ import numpy as np
 import optax
 from flax import struct
 
-from ddls_tpu.parallel.mesh import replicated_sharding, shard_batch
+from ddls_tpu.parallel.mesh import (place_state_tree,
+                                    replicated_sharding, shard_batch)
 
 
 @dataclasses.dataclass
@@ -246,7 +247,8 @@ class ApexDQNLearner:
     def init_state(self, params) -> DQNTrainState:
         params = jax.tree_util.tree_map(jnp.copy, params)
         state = DQNTrainState.create(params, self.tx)
-        return jax.device_put(state, self._replicated)
+        # multi-host-safe placement (see parallel/mesh.py:place_state_tree)
+        return place_state_tree(state, self._replicated)
 
     # ------------------------------------------------------------ acting
     def _masked_q(self, params, obs):

@@ -248,8 +248,23 @@ def table_cells(et) -> int:
                    for v in et.tables.values()))
 
 
+def memo_table_cells(et, memo_cfg) -> int:
+    """Captured-constant contribution of the in-kernel lookahead memo
+    (sim/jax_memo.py): the key-hash weights (1 + N + 2M u32 words) are
+    embedded as program literals. The memo TABLE itself is a carried
+    ARGUMENT, not a constant — it costs argument traffic and HBM, not
+    serialized-program bytes. ``memo_cfg`` is the knob value ("auto" /
+    MemoConfig / None); "auto" counts the cells because the size model
+    must upper-bound the lanes=1 candidate, where auto turns the memo
+    on."""
+    if memo_cfg is None:
+        return 0
+    return 1 + int(et.pads.n_ops) + 2 * int(et.pads.n_deps)
+
+
 def estimate_program_bytes(lanes: int, segment_len: int,
-                           n_table_cells: int) -> int:
+                           n_table_cells: int,
+                           n_memo_cells: int = 0) -> int:
     """Estimated serialized-program size of the fused epoch.
 
     A RANKING model, not a measurement: calibrated coarsely against the
@@ -261,7 +276,8 @@ def estimate_program_bytes(lanes: int, segment_len: int,
     the actual size (``AutotuneResult.actual_bytes``) for the artifact.
     """
     del segment_len  # scans do not grow the program with their length
-    return int(_BASE_BYTES + _TABLE_BYTES_PER_CELL * n_table_cells
+    return int(_BASE_BYTES
+               + _TABLE_BYTES_PER_CELL * (n_table_cells + n_memo_cells)
                + _BYTES_PER_LANE * lanes)
 
 
@@ -286,20 +302,23 @@ def candidate_configs(total_steps: int, dp: int,
 
 def workload_signature(et, total_steps: int, updates_per_epoch: int,
                        dp: int, max_lanes: int = 0,
-                       extra: str = "") -> str:
+                       extra: str = "", memo_cfg="auto") -> str:
     """Cache key for the autotuned config: everything the compiled
     program's size depends on — pad bounds, topology size, the
     model/degree config set, batch factorisation inputs (including the
     lane cap: a cached config must never carry more lanes than the
-    current run's num_envs allows), mesh width — hashed so a changed
-    workload can never serve a stale config."""
+    current run's num_envs allows), mesh width, and the lookahead-memo
+    knob (a memo-on lanes=1 program is a different program than a
+    memo-off one) — hashed so a changed workload can never serve a
+    stale config."""
     pads = dataclasses.asdict(et.pads)
     payload = json.dumps({
         "pads": pads, "n_srv": et.n_srv, "n_chan": et.n_chan,
         "types": list(et.types), "degrees": list(et.degrees),
         "max_action": et.max_action, "total_steps": total_steps,
         "updates_per_epoch": updates_per_epoch, "dp": dp,
-        "max_lanes": max_lanes, "extra": extra}, sort_keys=True)
+        "max_lanes": max_lanes, "extra": extra,
+        "memo": repr(memo_cfg)}, sort_keys=True)
     return hashlib.sha256(payload.encode()).hexdigest()[:24]
 
 
@@ -398,7 +417,8 @@ def autotune_fused(build_driver: Callable[[int, int],
                    probe_timeout_s: float = 240.0,
                    signature_extra: str = "",
                    lanes: Optional[int] = None,
-                   segment_len: Optional[int] = None
+                   segment_len: Optional[int] = None,
+                   memo_cfg="auto"
                    ) -> Tuple[Optional["FusedEpochDriver"],
                               AutotuneResult]:
     """Pick a compilable (lanes, segment_len) config and build its
@@ -414,7 +434,7 @@ def autotune_fused(build_driver: Callable[[int, int],
     LOUDLY (never silently).
     """
     probe_dir = probe_dir or default_probe_dir()
-    cells = table_cells(et)
+    cells = table_cells(et) + memo_table_cells(et, memo_cfg)
     if lanes is not None or segment_len is not None:
         if lanes is None or segment_len is None:
             raise ValueError("pass both lanes and segment_len (or "
@@ -438,7 +458,8 @@ def autotune_fused(build_driver: Callable[[int, int],
             actual_bytes=None, source="explicit")
 
     key = workload_signature(et, total_steps, updates_per_epoch, dp,
-                             max_lanes=max_lanes, extra=signature_extra)
+                             max_lanes=max_lanes, extra=signature_extra,
+                             memo_cfg=memo_cfg)
     cached = load_cached_config(probe_dir, key)
     if cached is not None:
         # a hand-edited/corrupt entry is re-probed, never obeyed: the
@@ -577,7 +598,7 @@ class FusedEpochDriver:
 
     def __init__(self, et, ot, model, banks: Dict, segment_len: int,
                  updates_per_epoch: int, train_step_fn: Callable,
-                 state_shardings=None, mesh=None):
+                 state_shardings=None, mesh=None, memo_cfg="auto"):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -586,6 +607,7 @@ class FusedEpochDriver:
         from ddls_tpu.rl.ppo import traj_donate_argnums
         from ddls_tpu.sim.jax_env import (_kernel_obs, make_segment_fn,
                                           segment_init, vmap_segment_fn)
+        from ddls_tpu.sim.jax_memo import resolve_memo_cfg
 
         self.et, self.ot, self.model = et, ot, model
         self.segment_len = int(segment_len)
@@ -595,6 +617,11 @@ class FusedEpochDriver:
         self.mesh = mesh
         self.env_steps_per_epoch = (self.updates_per_epoch
                                     * self.segment_len * self.num_lanes)
+        # in-kernel lookahead memo: "auto" enables it only at lanes=1 —
+        # the regime where the probe's lax.cond short-circuits (and the
+        # axon-preferred few-lanes x long-segments shape); the table
+        # rides the carried sim state across epochs like the rest of it
+        self.memo_cfg = resolve_memo_cfg(memo_cfg, self.num_lanes)
         T, B, U = self.segment_len, self.num_lanes, self.updates_per_epoch
         # trace_obs: the in-scan update carry — the update consumes the
         # segment's own observations instead of re-deriving them from
@@ -602,7 +629,8 @@ class FusedEpochDriver:
         # samples, measured ~30% of the fused epoch on CPU); same
         # _kernel_obs values either way, so parity with the sequential
         # rebuild-from-fields path is unchanged
-        segment = make_segment_fn(et, ot, model, T, trace_obs=True)
+        segment = make_segment_fn(et, ot, model, T, trace_obs=True,
+                                  memo_cfg=self.memo_cfg)
         # one-lane fast path shared with DevicePPOCollector (a 1-wide
         # vmap halves the kernel's XLA:CPU throughput)
         lane_segment = vmap_segment_fn(segment, self.num_lanes)
@@ -621,7 +649,8 @@ class FusedEpochDriver:
         self._banks = banks
         # per-lane initial sim state from each lane's OWN bank; carried
         # across fused_epoch calls like the collector's self._state
-        self._state = jax.vmap(lambda b: segment_init(et, b))(banks)
+        self._state = jax.vmap(
+            lambda b: segment_init(et, b, self.memo_cfg))(banks)
         self._ep_len = np.zeros(B, np.int64)
 
         def obs_from_fields(jtype, frac, steps, n_occ, n_run):
@@ -674,6 +703,9 @@ class FusedEpochDriver:
             urng, usub = jax.random.split(urng)
             state, metrics = train_step_fn(state, traj, last_values,
                                            usub)
+            # memo trace keys stay INSIDE the program (XLA DCEs the
+            # unused stacking): cumulative counters are reported from the
+            # carried memo state via memo_counters() at drain boundaries
             ep = {k: trace[k] for k in EPISODE_TRACE_KEYS}
             return (state, sim_state, crng, urng), (metrics, ep)
 
@@ -722,6 +754,17 @@ class FusedEpochDriver:
         (state, self._state, crng, urng, metrics,
          ep) = self._jit_epoch(state, self._state, crng, urng)
         return state, (crng, urng), metrics, ep
+
+    def memo_counters(self) -> Optional[Dict]:
+        """Cumulative in-kernel memo counters {hits, misses, evicts,
+        hit_rate} summed over lanes (drain/reporting boundaries only —
+        sim/jax_memo.py:summarize_counters); None when the memo is
+        off."""
+        from ddls_tpu.sim.jax_memo import summarize_counters
+
+        if self.memo_cfg is None:
+            return None
+        return summarize_counters(self._state[1])
 
     # --------------------------------------------------------- harvest
     def harvest_episodes(self, ep_trace) -> list:

@@ -26,7 +26,8 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ddls_tpu.parallel.mesh import replicated_sharding, shard_batch
+from ddls_tpu.parallel.mesh import (place_state_tree,
+                                    replicated_sharding, shard_batch)
 
 
 @dataclasses.dataclass
@@ -142,7 +143,8 @@ class ImpalaLearner:
     def init_state(self, params) -> ImpalaState:
         params = jax.tree_util.tree_map(jnp.copy, params)
         state = ImpalaState.create(params, self.tx)
-        return jax.device_put(state, self._replicated)
+        # multi-host-safe placement (see parallel/mesh.py:place_state_tree)
+        return place_state_tree(state, self._replicated)
 
     # ------------------------------------------------------------ acting
     def _sample_actions(self, params, obs, rng):

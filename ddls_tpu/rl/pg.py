@@ -23,7 +23,8 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ddls_tpu.parallel.mesh import replicated_sharding, shard_batch
+from ddls_tpu.parallel.mesh import (place_state_tree,
+                                    replicated_sharding, shard_batch)
 
 
 @dataclasses.dataclass
@@ -93,7 +94,8 @@ class PGLearner:
     def init_state(self, params) -> PGState:
         params = jax.tree_util.tree_map(jnp.copy, params)
         state = PGState.create(params, self.tx)
-        return jax.device_put(state, self._replicated)
+        # multi-host-safe placement (see parallel/mesh.py:place_state_tree)
+        return place_state_tree(state, self._replicated)
 
     def _sample_actions(self, params, obs, rng):
         logits, values = self.apply_fn(params, obs)

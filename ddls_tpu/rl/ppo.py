@@ -29,7 +29,8 @@ import optax
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ddls_tpu.parallel.mesh import replicated_sharding, shard_batch
+from ddls_tpu.parallel.mesh import (place_state_tree,
+                                    replicated_sharding, shard_batch)
 
 
 def traj_donate_argnums(state_argnum: int, *traj_argnums: int):
@@ -230,7 +231,12 @@ class PPOLearner:
                 out_shardings=(shardings, self._replicated),
                 donate_argnums=traj_donate_argnums(0, 1, 2))
         self._jit_train_step = self._jit_cache[key]
-        return jax.device_put(state, shardings)
+        # multi-host-safe placement: device_put onto a global sharding
+        # would run jax's per-leaf assert_equal broadcasts (gloo-
+        # colliding under process skew); the state is process-identical
+        # by the multi-host seed rules, so each process contributes its
+        # copy collective-free (parallel/mesh.py:place_state_tree)
+        return place_state_tree(state, shardings)
 
     # ------------------------------------------------------------ acting
     def _sample_actions(self, params, obs, rng):

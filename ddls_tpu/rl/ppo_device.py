@@ -14,7 +14,7 @@ collect (~116 ms each) with ONE dispatch.
 """
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -31,22 +31,33 @@ class DevicePPOCollector:
     collectives). This is the pod collection shape — the update already
     shards its batch over the same mesh, so without it a multi-chip
     slice would collect on one chip and update on all. Requires
-    ``num_envs`` divisible by the dp axis size."""
+    ``num_envs`` divisible by the dp axis size.
+
+    ``memo_cfg`` wires the in-kernel lookahead memo (sim/jax_memo.py):
+    ``"auto"`` (default) enables it only at num_envs=1 — the lanes=1
+    regime where the probe's lax.cond short-circuits; under a multi-lane
+    vmap the cond lowers to select and the memo is inert (correct, never
+    faster), so auto keeps it off there. Memo hit/miss counters ride the
+    per-collect trace and ``memo_counters()`` exposes the cumulative
+    totals (drain boundaries only)."""
 
     def __init__(self, et, ot, model, banks: Dict, rollout_length: int,
-                 mesh=None):
+                 mesh=None, memo_cfg="auto"):
         import jax
         import jax.numpy as jnp
 
         from ddls_tpu.rl.ppo import traj_donate_argnums
         from ddls_tpu.sim.jax_env import (make_segment_fn, segment_init,
                                           vmap_segment_fn)
+        from ddls_tpu.sim.jax_memo import resolve_memo_cfg
 
         self.et, self.ot, self.model = et, ot, model
         self.rollout_length = rollout_length
         self.num_envs = int(jax.tree_util.tree_leaves(banks)[0].shape[0])
         self.mesh = mesh
-        segment = make_segment_fn(et, ot, model, rollout_length)
+        self.memo_cfg = resolve_memo_cfg(memo_cfg, self.num_envs)
+        segment = make_segment_fn(et, ot, model, rollout_length,
+                                  memo_cfg=self.memo_cfg)
         lane_segment = vmap_segment_fn(segment, self.num_envs)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -98,7 +109,8 @@ class DevicePPOCollector:
                 lambda p, o: batched_policy_apply(model, p, o))
         # per-env initial state from each env's OWN bank (arrival clocks
         # differ across banks)
-        self._state = jax.vmap(lambda b: segment_init(et, b))(banks)
+        self._state = jax.vmap(
+            lambda b: segment_init(et, b, self.memo_cfg))(banks)
         # per-lane decision count of the in-flight episode (episodes span
         # segment boundaries; the kernel's counters reset in-kernel at
         # done, so length is tracked here)
@@ -128,12 +140,33 @@ class DevicePPOCollector:
         }
         next_obs = rebuild_obs_batch(self.et, self.ot, {
             k: np.asarray(v) for k, v in next_fields.items()})
-        _, last_values = self._jit_apply(params, {
-            k: np.asarray(v) for k, v in next_obs.items()})
+        next_obs = {k: np.asarray(v) for k, v in next_obs.items()}
+        if self.mesh is not None and jax.process_count() > 1:
+            # multi-process jax rejects numpy inputs against the jit's
+            # non-trivial (dp-sharded) in_shardings even on this fully-
+            # addressable LOCAL mesh — stage explicitly first (device_put
+            # to a local sharding is collective-free; same program, same
+            # bits as the single-process path)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            next_obs = jax.device_put(
+                next_obs, NamedSharding(self.mesh, P("dp")))
+        _, last_values = self._jit_apply(params, next_obs)
         return {"traj": traj,
                 "last_values": np.asarray(last_values, np.float32),
                 "env_steps": self.rollout_length * self.num_envs,
                 "episodes": self._harvest_episodes(trace)}
+
+    def memo_counters(self) -> Optional[Dict]:
+        """Cumulative in-kernel memo counters {hits, misses, evicts,
+        hit_rate}, summed over lanes (drain/reporting boundaries only —
+        sim/jax_memo.py:summarize_counters); None when the memo is
+        off."""
+        from ddls_tpu.sim.jax_memo import summarize_counters
+
+        if self.memo_cfg is None:
+            return None
+        return summarize_counters(self._state[1])
 
     def _harvest_episodes(self, trace) -> list:
         """Episode records at done boundaries, from the traced in-kernel

@@ -54,7 +54,16 @@ import numpy as np
 from ddls_tpu.agents.block_search import block_shapes_for, factor_pairs
 from ddls_tpu.agents.partitioners import build_partition_action
 from ddls_tpu.graphs.readers import backward_op_id
+from ddls_tpu.sim import jax_memo
 from ddls_tpu.sim.partition import partition_graph, partitioned_op_id
+
+#: episode-kernel default: the in-kernel lookahead memo (sim/jax_memo.py)
+#: is ON for the single-lane episode builders — memoised and recomputed
+#: lookaheads are bitwise identical by construction, so the x64 parity
+#: suites run with it enabled unchanged. Multi-lane vmap callers pass
+#: ``memo_cfg=None`` (under vmap the probe's lax.cond lowers to select
+#: and both branches run — correct but inert, pure overhead).
+DEFAULT_EPISODE_MEMO = jax_memo.MemoConfig()
 
 Coord = Tuple[int, int, int]
 
@@ -966,12 +975,17 @@ def _episode_kernels(et: EpisodeTables):
     eps = et.eps
     sim_end = et.sim_end
 
-    def eval_cfg(bank, carry, row, cfg):
+    def eval_cfg(bank, carry, row, cfg, memo=None):
         """Evaluate ONE (job, degree) candidate against the live cluster
         state: placement, dep pricing, channel check, lookahead, SLA —
         everything a decision needs, minus the commit. XLA dead-code
         eliminates the commit outputs when a caller (candidate pricing)
-        only reads (ok, jct)."""
+        only reads (ok, jct). Returns ``(ev, memo)``; with ``memo`` (the
+        in-kernel lookahead memo table, sim/jax_memo.py) the lookahead is
+        probed under the host memo-key signature (cfg row, canonical
+        worker grouping, mounted dep times) and served from the table on
+        a bitwise full-key hit — memoised and recomputed results are
+        bit-identical by construction, any precision mode."""
         (t, mem, srv_job, chan_occ, slot_valid, slot_t_done, slot_mem,
          slot_servers, slot_chan) = carry
         dt = mem.dtype
@@ -987,14 +1001,25 @@ def _episode_kernels(et: EpisodeTables):
 
         from ddls_tpu.sim.jax_lookahead import jax_lookahead
         op_valid = et.tables["op_valid"][cfg]
-        t_step, _, _, _, ok_la = jax_lookahead(
-            et.tables["op_compute"][cfg], op_valid,
-            jnp.where(op_valid, ots, -1), op_score,
-            et.tables["num_parents"][cfg], times,
-            et.tables["dep_valid"][cfg], et.tables["dep_src"][cfg],
-            et.tables["dep_dst"][cfg], et.tables["dep_mutual"][cfg],
-            is_flow, dep_score, chan[:, None],
-            num_workers=n_srv, num_channels=n_chan)
+
+        def run_lookahead():
+            t_la, _, _, _, ok = jax_lookahead(
+                et.tables["op_compute"][cfg], op_valid,
+                jnp.where(op_valid, ots, -1), op_score,
+                et.tables["num_parents"][cfg], times,
+                et.tables["dep_valid"][cfg], et.tables["dep_src"][cfg],
+                et.tables["dep_dst"][cfg], et.tables["dep_mutual"][cfg],
+                is_flow, dep_score, chan[:, None],
+                num_workers=n_srv, num_channels=n_chan)
+            return t_la, ok
+
+        if memo is None:
+            t_step, ok_la = run_lookahead()
+        else:
+            groups = jax_memo.canonical_groups(
+                jnp.where(op_valid, ots, -1), op_valid)
+            (t_step, ok_la), memo = jax_memo.memo_lookahead(
+                memo, cfg, groups, times, run_lookahead)
         jct = t_step * steps
         max_jct = (bank["sla_frac"][row].astype(dt)
                    * et.tables["seq_compute"][cfg].astype(dt) * steps)
@@ -1007,7 +1032,7 @@ def _episode_kernels(et: EpisodeTables):
         return {"ok_place": ok_place, "ok_chan": ok_chan,
                 "engine_ok": engine_ok, "sla_ok": sla_ok, "jct": jct,
                 "new_mem": new_mem, "srv_mask": srv_mask,
-                "chan_mask": chan_mask}
+                "chan_mask": chan_mask}, memo
 
     def price_all(bank, carry, row):
         """In-kernel candidate pricing: (placeable [n_deg], jct [n_deg])
@@ -1018,20 +1043,24 @@ def _episode_kernels(et: EpisodeTables):
         once, not n_deg times."""
         jtype = bank["type"][row]
         cfgs = jtype * n_deg + jnp.arange(n_deg, dtype=jnp.int32)
-        ev = jax.vmap(eval_cfg, in_axes=(None, None, None, 0))(
+        # memo-less on purpose: under this vmap the probe's lax.cond
+        # would lower to select and compute both branches anyway
+        # (sim/jax_memo.py vmap hazard) — the host counterpart keeps
+        # candidate pricing fast through its own prefetch instead
+        ev, _ = jax.vmap(eval_cfg, in_axes=(None, None, None, 0))(
             bank, carry, row, cfgs)
         return (ev["ok_place"] & ev["ok_chan"] & ev["engine_ok"],
                 ev["jct"])
 
-    def decision(bank, carry, action, row):
+    def decision(bank, carry, action, row, memo=None):
         (t, mem, srv_job, chan_occ, slot_valid, slot_t_done, slot_mem,
          slot_servers, slot_chan) = carry
         dt = mem.dtype
         jtype = bank["type"][row]
         cfg = jtype * n_deg + deg_col[jnp.clip(action, 0)]
 
-        def heavy(_):
-            ev = eval_cfg(bank, carry, row, cfg)
+        def heavy(mm):
+            ev, mm = eval_cfg(bank, carry, row, cfg, mm)
             accept = (ev["ok_place"] & ev["ok_chan"] & ev["sla_ok"]
                       & ev["engine_ok"])
             cause = jnp.where(
@@ -1041,20 +1070,20 @@ def _episode_kernels(et: EpisodeTables):
                                     jnp.where(~ev["sla_ok"], CAUSE_SLA,
                                               CAUSE_ACCEPTED))))
             return (accept, cause.astype(jnp.int32), ev["jct"],
-                    ev["new_mem"], ev["srv_mask"], ev["chan_mask"])
+                    ev["new_mem"], ev["srv_mask"], ev["chan_mask"]), mm
 
-        def zero(_):
+        def zero(mm):
             return (jnp.bool_(False), jnp.int32(CAUSE_NOT_HANDLED),
                     jnp.zeros((), dt), mem, jnp.zeros((n_srv,), bool),
-                    jnp.zeros((n_chan,), bool))
+                    jnp.zeros((n_chan,), bool)), mm
 
         # actions outside the jitted degree set (odd > 1 — the host
         # coerces masked-invalid actions to 0, partitioning_env.py:195)
         # take the zero path instead of wrapping deg_col's -1 into
         # another config row
         action_ok = (action > 0) & (deg_col[jnp.clip(action, 0)] >= 0)
-        (accept, cause, jct, new_mem, srv_mask, chan_mask) = jax.lax.cond(
-            action_ok, heavy, zero, operand=None)
+        ((accept, cause, jct, new_mem, srv_mask, chan_mask),
+         memo) = jax.lax.cond(action_ok, heavy, zero, memo)
 
         slot = jnp.argmin(slot_valid).astype(jnp.int32)  # first free slot
         accept = accept & ~jnp.all(slot_valid)  # cannot trigger (R=n_srv)
@@ -1076,7 +1105,7 @@ def _episode_kernels(et: EpisodeTables):
 
         return ((t, mem2, srv_job2, chan_occ2, slot_valid2, slot_t_done2,
                  slot_mem2, slot_servers2, slot_chan2),
-                (reward.astype(dt), accept, cause, jct))
+                (reward.astype(dt), accept, cause, jct), memo)
 
     def advance(bank, carry, queue_row, ptr, next_arrival, done,
                 completed):
@@ -1153,7 +1182,9 @@ def _episode_kernels(et: EpisodeTables):
                                   price_all=price_all)
 
 
-def make_episode_fn(et: EpisodeTables):
+def make_episode_fn(et: EpisodeTables,
+                    memo_cfg: Optional[jax_memo.MemoConfig]
+                    = DEFAULT_EPISODE_MEMO):
     """Build the jitted episode replay: (bank, actions [n_decisions]) ->
     per-decision traces (reward, accept, cause, jct, t) + final counters.
 
@@ -1162,6 +1193,13 @@ def make_episode_fn(et: EpisodeTables):
     `lax.cond` (skipped for action 0), then a `lax.while_loop` advances
     the event clock (completions, arrivals) to the next decision exactly
     like `RampClusterEnvironment.step`'s tick loop (cluster.py:616-657).
+
+    The in-kernel lookahead memo (``memo_cfg``, sim/jax_memo.py) rides
+    the scan carry and defaults ON — hits and recomputes are bitwise
+    identical, so results never depend on it. Pass ``memo_cfg=None``
+    when vmapping this kernel (the probe cond lowers to select under
+    vmap: correct but inert). With the memo on, the output dict carries
+    the final ``memo_hits``/``memo_misses``/``memo_evicts`` counters.
     """
     import jax
     import jax.numpy as jnp
@@ -1172,23 +1210,24 @@ def make_episode_fn(et: EpisodeTables):
     def episode(bank, actions):
         dt = et.tables["dep_size"].dtype
 
-        def scan_body(state, action):
+        def scan_body(sm, action):
+            state, memo = sm
             (carry, queue_row, ptr, next_arrival, done, completed,
              counters) = state
             t = carry[0]
             has_job = (queue_row >= 0) & ~done
 
-            def run(_):
-                new_carry, (reward, accept, cause, jct) = decision(
-                    bank, carry, action, jnp.clip(queue_row, 0))
-                return new_carry, reward, accept, cause, jct
+            def run(mm):
+                new_carry, (reward, accept, cause, jct), mm = decision(
+                    bank, carry, action, jnp.clip(queue_row, 0), mm)
+                return (new_carry, reward, accept, cause, jct), mm
 
-            def skip(_):
+            def skip(mm):
                 return (carry, jnp.zeros((), dt), jnp.bool_(False),
-                        jnp.int32(-1), jnp.zeros((), dt))
+                        jnp.int32(-1), jnp.zeros((), dt)), mm
 
-            new_carry, reward, accept, cause, jct = jax.lax.cond(
-                has_job, run, skip, operand=None)
+            (new_carry, reward, accept, cause, jct), memo = jax.lax.cond(
+                has_job, run, skip, memo)
             accepted, blocked, ret = counters
             counters2 = (accepted + (has_job & accept),
                          blocked + (has_job & ~accept),
@@ -1198,22 +1237,27 @@ def make_episode_fn(et: EpisodeTables):
              completed3) = advance(bank, new_carry, queue_row2, ptr,
                                    next_arrival, done, completed)
             out = (reward, accept, cause, jct, t, has_job)
-            return ((carry3, queue_row3, ptr3, next_arrival3, done3,
-                     completed3, counters2), out)
+            return (((carry3, queue_row3, ptr3, next_arrival3, done3,
+                      completed3, counters2), memo), out)
 
-        state0 = k.init_state(bank)
-        final, trace = jax.lax.scan(scan_body, state0, actions)
+        memo0 = (jax_memo.memo_init(et, memo_cfg)
+                 if memo_cfg is not None else None)
+        state0 = (k.init_state(bank), memo0)
+        (final, memo), trace = jax.lax.scan(scan_body, state0, actions)
         (carry, queue_row, ptr, next_arrival, done, completed,
          counters) = final
-        return {"trace": trace, "accepted": counters[0],
-                "blocked": counters[1], "ret": counters[2],
-                "completed": completed, "t": carry[0], "done": done,
-                # host episode finalisation blocks anything still running
-                # at simulation end (cluster.py:1010-1013); num_jobs_blocked
-                # parity = decision blocks + still-running slots
-                "blocked_total": (counters[1]
-                                  + carry[4].sum().astype(jnp.int32)),
-                "arrived": ptr}
+        out = {"trace": trace, "accepted": counters[0],
+               "blocked": counters[1], "ret": counters[2],
+               "completed": completed, "t": carry[0], "done": done,
+               # host episode finalisation blocks anything still running
+               # at simulation end (cluster.py:1010-1013); num_jobs_blocked
+               # parity = decision blocks + still-running slots
+               "blocked_total": (counters[1]
+                                 + carry[4].sum().astype(jnp.int32)),
+               "arrived": ptr}
+        if memo is not None:
+            out.update(jax_memo.memo_trace_counters(memo))
+        return out
 
     # bank arrays are traced arguments: one compile serves every bank of
     # the same shape (per-seed episodes, vmapped batches)
@@ -1355,14 +1399,17 @@ def _kernel_obs(ot: dict, et: EpisodeTables, jtype, frac, steps,
 
 
 def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
-                           greedy: bool = False):
+                           greedy: bool = False,
+                           memo_cfg: Optional[jax_memo.MemoConfig]
+                           = DEFAULT_EPISODE_MEMO):
     """Full policy-in-the-loop jitted episode: (bank, params, rng) ->
     traces. Per decision the kernel rebuilds the observation, runs the
     GNN policy forward, samples (or argmaxes) an action under the mask,
     then executes the decision + event clock exactly like
     `make_episode_fn`. ONE device dispatch per episode — the complete
     §5.8 HBM-resident rollout shape; vmap over (bank, rng) for batched
-    collection."""
+    collection (pass ``memo_cfg=None`` there: under vmap the memo's
+    probe cond lowers to select and is inert — sim/jax_memo.py)."""
     import jax
     import jax.numpy as jnp
 
@@ -1371,14 +1418,15 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
     def episode(bank, params, rng):
         dt = et.tables["dep_size"].dtype
 
-        def scan_body(state, step_rng):
+        def scan_body(sm, step_rng):
+            state, memo = sm
             (carry, queue_row, ptr, next_arrival, done, completed,
              counters) = state
             t = carry[0]
             has_job = (queue_row >= 0) & ~done
             row = jnp.clip(queue_row, 0)
 
-            def run(_):
+            def run(mm):
                 # obs rebuild + GNN forward + sampling live INSIDE the
                 # cond so dead scan steps after episode end cost nothing
                 srv_job = carry[2]
@@ -1418,19 +1466,19 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
                     action = jax.random.categorical(
                         step_rng, logits).astype(jnp.int32)
                 logp = jax.nn.log_softmax(logits)[action]
-                new_carry, (reward, accept, cause, jct) = k.decision(
-                    bank, carry, action, row)
+                new_carry, (reward, accept, cause, jct), mm = k.decision(
+                    bank, carry, action, row, mm)
                 return (new_carry, action, logp, value, reward, accept,
-                        cause, jct)
+                        cause, jct), mm
 
-            def skip(_):
+            def skip(mm):
                 f32 = jnp.float32
                 return (carry, jnp.int32(0), f32(0.0), f32(0.0),
                         jnp.zeros((), dt), jnp.bool_(False),
-                        jnp.int32(-1), jnp.zeros((), dt))
+                        jnp.int32(-1), jnp.zeros((), dt)), mm
 
-            (new_carry, action, logp, value, reward, accept, cause,
-             jct) = jax.lax.cond(has_job, run, skip, operand=None)
+            ((new_carry, action, logp, value, reward, accept, cause,
+              jct), memo) = jax.lax.cond(has_job, run, skip, memo)
             accepted, blocked, ret = counters
             counters2 = (accepted + (has_job & accept),
                          blocked + (has_job & ~accept),
@@ -1442,27 +1490,32 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
                                      completed)
             out = (action, logp, value, reward, accept, cause, jct, t,
                    has_job)
-            return ((carry3, queue_row3, ptr3, next_arrival3, done3,
-                     completed3, counters2), out)
+            return (((carry3, queue_row3, ptr3, next_arrival3, done3,
+                      completed3, counters2), memo), out)
 
-        state0 = k.init_state(bank)
+        memo0 = (jax_memo.memo_init(et, memo_cfg)
+                 if memo_cfg is not None else None)
+        state0 = (k.init_state(bank), memo0)
         n_steps = bank["type"].shape[0]
         rngs = jax.random.split(rng, n_steps)
-        final, trace = jax.lax.scan(scan_body, state0, rngs)
+        (final, memo), trace = jax.lax.scan(scan_body, state0, rngs)
         counters = final[6]
-        return {"trace": trace, "accepted": counters[0],
-                "blocked": counters[1], "ret": counters[2],
-                "completed": final[5], "t": final[0][0],
-                "done": final[4],
-                # host episode finalisation blocks anything still running
-                # at simulation end (cluster.py:1010-1013); num_jobs_blocked
-                # parity = decision blocks + still-running slots
-                "blocked_total": (counters[1]
-                                  + final[0][4].sum().astype(jnp.int32)),
-                # ptr = jobs that entered the queue (host num_jobs_arrived
-                # semantics, cluster.py:240) — the same expression the
-                # segment kernel traces as ep_arrived
-                "arrived": final[2]}
+        out = {"trace": trace, "accepted": counters[0],
+               "blocked": counters[1], "ret": counters[2],
+               "completed": final[5], "t": final[0][0],
+               "done": final[4],
+               # host episode finalisation blocks anything still running
+               # at simulation end (cluster.py:1010-1013); num_jobs_blocked
+               # parity = decision blocks + still-running slots
+               "blocked_total": (counters[1]
+                                 + final[0][4].sum().astype(jnp.int32)),
+               # ptr = jobs that entered the queue (host num_jobs_arrived
+               # semantics, cluster.py:240) — the same expression the
+               # segment kernel traces as ep_arrived
+               "arrived": final[2]}
+        if memo is not None:
+            out.update(jax_memo.memo_trace_counters(memo))
+        return out
 
     return jax.jit(episode)
 
@@ -1472,13 +1525,20 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
 # on device across collect calls; episodes reset in-kernel.
 # =========================================================================
 
-def segment_init(et: EpisodeTables, bank):
-    """Initial carried simulator state for `make_segment_fn`."""
-    return _episode_kernels(et).init_state(bank)
+def segment_init(et: EpisodeTables, bank,
+                 memo_cfg: Optional[jax_memo.MemoConfig] = None):
+    """Initial carried simulator state for `make_segment_fn`. With
+    ``memo_cfg`` the state is ``(env_state, memo_table)`` — pass the
+    SAME config the segment fn was built with."""
+    state = _episode_kernels(et).init_state(bank)
+    if memo_cfg is None:
+        return state
+    return (state, jax_memo.memo_init(et, memo_cfg))
 
 
 def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
-                    trace_obs: bool = False):
+                    trace_obs: bool = False,
+                    memo_cfg: Optional[jax_memo.MemoConfig] = None):
     """(bank, params, sim_state, rng) -> (new_sim_state, trace, next_fields)
 
     Exactly ``n_steps`` policy decisions per call — the [T, B] segment
@@ -1492,6 +1552,19 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
     exact observation on host for the learner's re-forward.
     ``next_fields`` are the same fields for the bootstrap state after the
     segment.
+
+    ``memo_cfg`` threads the in-kernel lookahead memo (sim/jax_memo.py)
+    through the carried state as ``(env_state, memo_table)``; per-step
+    cumulative ``memo_hits``/``memo_misses``/``memo_evicts`` counters
+    ride the trace next to the episode counters (drained with them at
+    sync boundaries). THE PERSISTENCE CONTRACT: the in-kernel episode
+    reset below restores the env state to ``fresh`` but NEVER touches
+    the memo — the exact mirror of the host ``cluster.lookahead_cache``
+    persisting across ``reset()`` under an unchanged workload signature
+    (each lane replays one fixed bank, so its signature never changes).
+    Enable only for single-lane use (``jax_memo.resolve_memo_cfg``):
+    under a multi-lane vmap the probe cond lowers to select and the
+    memo is inert.
 
     ``trace_obs=True`` additionally carries the FULL observation dict the
     in-scan policy forward consumed (``trace["obs"]``) — the in-scan
@@ -1529,9 +1602,14 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
 
     def segment(bank, params, sim_state, rng):
         dt = et.tables["dep_size"].dtype
+        if memo_cfg is not None:
+            sim_state, memo0 = sim_state
+        else:
+            memo0 = None
         fresh = k.init_state(bank)
 
-        def scan_body(state, step_rng):
+        def scan_body(sm, step_rng):
+            state, memo = sm
             (carry, queue_row, ptr, next_arrival, done, completed,
              counters) = state
             row = jnp.clip(queue_row, 0)
@@ -1544,8 +1622,8 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
                                             logits).astype(jnp.int32)
             logp = jax.nn.log_softmax(logits)[action]
 
-            new_carry, (reward, accept, cause, jct) = k.decision(
-                bank, carry, action, row)
+            new_carry, (reward, accept, cause, jct), memo = k.decision(
+                bank, carry, action, row, memo)
             accepted, blocked, ret = counters
             # unlike the policy-episode kernel these counters need no
             # has_job guard: every segment step has a queued job by
@@ -1560,7 +1638,10 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
             ended = done3
             state3 = (carry3, queue_row3, ptr3, next_arrival3, done3,
                       completed3, counters2)
-            # in-kernel episode reset: a fresh run of the same bank
+            # in-kernel episode reset: a fresh run of the same bank.
+            # The memo is deliberately OUTSIDE this tree_map — it
+            # persists across resets like the host lookahead_cache
+            # (workload signature unchanged: same bank every episode)
             state4 = jax.tree_util.tree_map(
                 lambda f, s: jnp.where(ended, f, s), fresh, state3)
             # episode counters ride the trace so the training loop can
@@ -1585,11 +1666,15 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
                    **fields}
             if trace_obs:
                 out["obs"] = obs
-            return state4, out
+            if memo is not None:
+                out.update(jax_memo.memo_trace_counters(memo))
+            return (state4, memo), out
 
         rngs = jax.random.split(rng, n_steps)
-        final, trace = jax.lax.scan(scan_body, sim_state, rngs)
-        return final, trace, obs_fields(bank, final)
+        (final, memo), trace = jax.lax.scan(scan_body, (sim_state, memo0),
+                                            rngs)
+        ret_state = final if memo_cfg is None else (final, memo)
+        return ret_state, trace, obs_fields(bank, final)
 
     return jax.jit(segment)
 
@@ -1653,13 +1738,16 @@ def rebuild_obs_batch(et: EpisodeTables, ot: dict, fields: dict):
 # action selection, decision, event clock — one dispatch per episode.
 # =========================================================================
 
-def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
+def make_oracle_episode_fn(et: EpisodeTables, ot: dict,
+                           memo_cfg: Optional[jax_memo.MemoConfig]
+                           = DEFAULT_EPISODE_MEMO):
     """Jitted OracleJCT episodes: per decision, price EVERY candidate
     degree in-kernel (`price_all`), pick the smallest degree whose priced
     JCT meets the SLA (else the smallest-JCT placeable candidate, else
     the smallest valid degree, else 0 — exactly
     `envs/baselines.py:OracleJCT.compute_action`), then run the decision
-    and event clock. (bank) -> traces.
+    and event clock. (bank) -> traces. The memo serves the DECISION's
+    lookahead only (candidate pricing stays vmapped — memo inert there).
     """
     import jax
     import jax.numpy as jnp
@@ -1671,14 +1759,15 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
     def episode(bank):
         dt = et.tables["dep_size"].dtype
 
-        def scan_body(state, _):
+        def scan_body(sm, _):
+            state, memo = sm
             (carry, queue_row, ptr, next_arrival, done, completed,
              counters) = state
             t = carry[0]
             has_job = (queue_row >= 0) & ~done
             row = jnp.clip(queue_row, 0)
 
-            def run(_):
+            def run(mm):
                 srv_job = carry[2]
                 # the obs action mask restricted to the degree columns
                 mask = _kernel_action_mask(
@@ -1716,17 +1805,18 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
                     jnp.where(best_deg >= 0, best_deg, first_valid)
                 ).astype(jnp.int32)
 
-                new_carry, (reward, accept, cause, jct) = k.decision(
-                    bank, carry, action, row)
-                return (new_carry, action, reward, accept, cause, jct)
+                new_carry, (reward, accept, cause, jct), mm = k.decision(
+                    bank, carry, action, row, mm)
+                return (new_carry, action, reward, accept, cause,
+                        jct), mm
 
-            def skip(_):
+            def skip(mm):
                 return (carry, jnp.int32(0), jnp.zeros((), dt),
                         jnp.bool_(False), jnp.int32(-1),
-                        jnp.zeros((), dt))
+                        jnp.zeros((), dt)), mm
 
-            (new_carry, action, reward, accept, cause, jct) = jax.lax.cond(
-                has_job, run, skip, operand=None)
+            ((new_carry, action, reward, accept, cause, jct),
+             memo) = jax.lax.cond(has_job, run, skip, memo)
             accepted, blocked, ret = counters
             counters2 = (accepted + (has_job & accept),
                          blocked + (has_job & ~accept),
@@ -1736,22 +1826,27 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict):
              completed3) = k.advance(bank, new_carry, queue_row2, ptr,
                                      next_arrival, done, completed)
             out = (action, reward, accept, cause, jct, t, has_job)
-            return ((carry3, queue_row3, ptr3, next_arrival3, done3,
-                     completed3, counters2), out)
+            return (((carry3, queue_row3, ptr3, next_arrival3, done3,
+                      completed3, counters2), memo), out)
 
-        state0 = k.init_state(bank)
+        memo0 = (jax_memo.memo_init(et, memo_cfg)
+                 if memo_cfg is not None else None)
+        state0 = (k.init_state(bank), memo0)
         n_steps = bank["type"].shape[0]
-        final, trace = jax.lax.scan(scan_body, state0, None,
-                                    length=n_steps)
+        (final, memo), trace = jax.lax.scan(scan_body, state0, None,
+                                            length=n_steps)
         counters = final[6]
-        return {"trace": trace, "accepted": counters[0],
-                "blocked": counters[1], "ret": counters[2],
-                "completed": final[5], "t": final[0][0],
-                "done": final[4],
-                # host-parity blocked count incl. jobs still running at
-                # simulation end (cluster.py:1010-1013)
-                "blocked_total": (counters[1]
-                                  + final[0][4].sum().astype(jnp.int32)),
-                "arrived": final[2]}
+        out = {"trace": trace, "accepted": counters[0],
+               "blocked": counters[1], "ret": counters[2],
+               "completed": final[5], "t": final[0][0],
+               "done": final[4],
+               # host-parity blocked count incl. jobs still running at
+               # simulation end (cluster.py:1010-1013)
+               "blocked_total": (counters[1]
+                                 + final[0][4].sum().astype(jnp.int32)),
+               "arrived": final[2]}
+        if memo is not None:
+            out.update(jax_memo.memo_trace_counters(memo))
+        return out
 
     return jax.jit(episode)

@@ -1,0 +1,269 @@
+"""Device-resident lookahead memo for the jitted environment (ISSUE 13).
+
+The host simulator memoises the SRPT lookahead under an exact signature
+(`cluster.py:452-520` ``_lookahead_cache_key``: the split/degree map, the
+canonical first-appearance worker grouping, and the placed per-dep times)
+and hits >80% past the ~300-step transient — the single biggest reason
+the warmed host sim out-steps the in-kernel env at the canonical
+degree-16 pads (docs/perf_round8.md). This module mirrors that memo into
+a fixed-capacity, set-associative table of jax arrays carried through
+the episode/segment scan, so the in-kernel env stops recomputing the
+lookahead from scratch on every decision.
+
+Key contract (the host signature, in-kernel form):
+
+* ``cfg`` — the (model type, partition degree) config-row index. The
+  split map is a pure function of (model, degree) (`config_tables_for`
+  builds one table row per pair), so this one i32 subsumes the host
+  key's ``(model, split)`` components.
+* ``groups`` — the canonical first-appearance renumbering of the per-op
+  server codes (:func:`canonical_groups`), the traced mirror of the
+  host's vectorised ``np.unique``/argsort canonicalisation
+  (cluster.py:468-476). Collapses physical server identity exactly like
+  the host: all workers are identical and servers symmetric.
+* ``times`` — the MOUNTED per-dep times (non-flow deps zeroed), byte-for
+  -byte what the host keys on: ``_assemble_lookahead_key`` reads
+  ``dep_init_run_time_arr`` AFTER ``_register_running_job`` (and
+  candidate pricing after its own ``set_dep_init_run_times_bulk``)
+  zeroed the non-flows.
+
+Exactness: the jitted lookahead consumes, beyond cfg-static tables,
+(op_worker, op_score, dep_remaining, is_flow, dep_score, dep_channel).
+Given the key triple these are determined up to relabelings the engine
+is invariant under: worker/channel ids enter only as occupancy indices
+(one-hot rows / scatter-max buckets — permutation invariant), op scores
+are a pure function of (cfg, grouping), and dep scores are compared only
+BETWEEN flow deps, whose relative SRPT order is the descending order of
+their own (mounted == raw) times — non-flow raw times shift all flow
+ranks monotonically and cancel in every comparison the engine makes.
+Hash collisions cannot break any of this: the probe compares the FULL
+key residual bitwise (u32 bit patterns, so ``-0.0``/NaN can only miss,
+never alias), so a collision is a miss, never a wrong entry.
+
+Bitwise-hit guarantee: a hit serves a value previously computed by the
+SAME compiled ``jax_lookahead`` on bit-identical inputs, so memo-on and
+memo-off episodes are indistinguishable in any precision mode — the x64
+full-episode parity suites run with the memo enabled unchanged.
+
+vmap hazard (documented per ISSUE 13): under a multi-lane ``vmap`` the
+probe's ``lax.cond`` lowers to ``select`` and BOTH branches execute —
+the memoised lookahead is still computed on hits, so the memo is
+correct but INERT (pure overhead) there. ``resolve_memo_cfg`` therefore
+defaults the memo on only for lanes=1, the regime that matters on the
+tunnelled TPU anyway (round 4: few lanes x long segments).
+
+Persistence: the table rides the scan carry OUTSIDE the in-kernel
+episode reset (`make_segment_fn` resets the env state to ``fresh`` but
+never the memo), mirroring the host contract that
+``cluster.lookahead_cache`` persists across ``reset()`` while the
+workload signature is unchanged — the jitted env replays one fixed bank
+per lane, so its workload signature never changes between resets.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple, Union
+
+import numpy as np
+
+#: host key builders this module mirrors — the lint engine's
+#: backend-surface-parity rule checks each still exists in
+#: ``sim/cluster.py``, so a host key-builder rename fails at lint time
+#: instead of silently diverging the in-kernel key contract.
+HOST_KEY_SURFACE = ("lookahead_key_for", "_assemble_lookahead_key")
+
+#: cumulative counter keys the memo-enabled segment kernel traces per
+#: step alongside the ``ep_*`` episode counters (drained with them at
+#: sync boundaries, never fetched per step).
+MEMO_TRACE_KEYS = ("memo_hits", "memo_misses", "memo_evicts")
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoConfig:
+    """Table geometry: ``n_sets`` x ``n_ways`` entries, round-robin way
+    eviction per set. The default 64x2 holds 128 keys — comfortably
+    above the distinct (model, degree, grouping, times) population of a
+    steady-state canonical episode, at ~13 MB of key residuals for the
+    degree-16 pads in f64 (N=480 groups + M=13072 times per entry)."""
+    n_sets: int = 64
+    n_ways: int = 2
+
+
+def resolve_memo_cfg(memo_cfg: Union[str, MemoConfig, None],
+                     n_lanes: int) -> Optional[MemoConfig]:
+    """The ONE resolution home for the ``use_jax_lookahead_memo`` knob:
+    ``"auto"`` enables the memo only at lanes=1 (where ``lax.cond``
+    actually short-circuits — under multi-lane vmap the cond lowers to
+    select, both branches run, and the memo is inert), an explicit
+    MemoConfig/None forces it on/off."""
+    if memo_cfg == "auto":
+        return MemoConfig() if n_lanes == 1 else None
+    if memo_cfg is None or isinstance(memo_cfg, MemoConfig):
+        return memo_cfg
+    raise ValueError(f"memo_cfg must be 'auto', None or a MemoConfig, "
+                     f"got {memo_cfg!r}")
+
+
+def _hash_weights(n_words: int) -> np.ndarray:
+    """Deterministic odd u32 multipliers for the key hash (embedded as
+    program constants; counted by the fused autotuner's size model via
+    ``rl/fused.py:memo_table_cells``). The hash only picks the set — the
+    bitwise residual compare makes its quality a perf knob, not a
+    correctness one."""
+    r = np.random.RandomState(0x5EED)
+    w = r.randint(0, 1 << 31, size=n_words, dtype=np.int64).astype(
+        np.uint32)
+    return (w << np.uint32(1)) | np.uint32(1)
+
+
+def memo_init(et, cfg: MemoConfig):
+    """A fresh (empty) device-resident memo table sized to ``et``'s pads.
+
+    Keys are stored as their raw components (cfg row, canonical groups,
+    mounted times); values are exactly what the decision kernel consumes
+    from ``jax_lookahead`` — the per-step time and the convergence flag.
+    Counters are i32 scalars traced alongside the episode counters."""
+    import jax.numpy as jnp
+
+    N, M = et.pads.n_ops, et.pads.n_deps
+    dt = et.tables["dep_size"].dtype
+    S, W = cfg.n_sets, cfg.n_ways
+    return {
+        "key_cfg": jnp.full((S, W), -1, jnp.int32),
+        "key_groups": jnp.zeros((S, W, N), jnp.int32),
+        "key_times": jnp.zeros((S, W, M), dt),
+        "val_t": jnp.zeros((S, W), dt),
+        "val_ok": jnp.zeros((S, W), bool),
+        "rr": jnp.zeros((S,), jnp.int32),
+        "hits": jnp.zeros((), jnp.int32),
+        "misses": jnp.zeros((), jnp.int32),
+        "evicts": jnp.zeros((), jnp.int32),
+    }
+
+
+def canonical_groups(ots, valid):
+    """First-appearance renumbering of the per-op server codes — the
+    traced mirror of the host's canonicalisation (cluster.py:468-476:
+    ``np.unique(return_index, return_inverse)`` + double argsort).
+    ``ots`` [N] i32 server codes; ``valid`` [N] bool. Invalid slots map
+    to -1 (their count and positions are cfg-static, so they can never
+    distinguish two placements of the same cfg)."""
+    import jax.numpy as jnp
+
+    n = ots.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    same = ((ots[None, :] == ots[:, None])
+            & valid[None, :] & valid[:, None])
+    # first[i] = smallest j with the same server as op i (== i when op i
+    # is its server's first appearance)
+    first = jnp.min(jnp.where(same, idx[None, :], jnp.int32(n)), axis=1)
+    is_first = valid & (first == idx)
+    rank_at = jnp.cumsum(is_first.astype(jnp.int32)) - 1
+    return jnp.where(valid, rank_at[jnp.clip(first, 0, n - 1)],
+                     jnp.int32(-1)).astype(jnp.int32)
+
+
+def _bits(x):
+    """Raw u32 bit pattern of a float array, flattened over the trailing
+    word axis bitcast introduces for 64-bit dtypes — the ONLY equality
+    the probe uses (bitwise: ``-0.0 != 0.0``, NaN never matches, exactly
+    the host's ``arr.tobytes()`` key semantics)."""
+    import jax
+    import jax.numpy as jnp
+
+    b = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    return b.reshape(x.shape[:-1] + (-1,)) if b.ndim > x.ndim else b
+
+
+def memo_lookahead(memo: dict, cfg, groups, times,
+                   compute: Callable[[], Tuple]):
+    """Probe-or-compute one lookahead under the memo key (cfg, groups,
+    times); returns ``((t, ok), memo')``.
+
+    Probe: hash the key onto a set, compare the FULL residual bitwise
+    against every way; any match serves the stored value through
+    ``lax.cond`` — at lanes=1 the miss branch (the lookahead while-loop)
+    is genuinely skipped. Miss: ``compute()`` runs the lookahead and the
+    (key, value) is inserted at the set's round-robin way (deterministic
+    eviction — same decision stream, same table, every run)."""
+    import jax
+    import jax.numpy as jnp
+
+    S, W = memo["key_cfg"].shape
+    n_groups = memo["key_groups"].shape[-1]
+
+    cfg = jnp.asarray(cfg, jnp.int32)
+    tbits = _bits(times).reshape(-1)
+    payload = jnp.concatenate([
+        cfg.astype(jnp.uint32).reshape(1),
+        groups.astype(jnp.uint32),
+        tbits,
+    ])
+    weights = jnp.asarray(_hash_weights(1 + n_groups + tbits.shape[0]))
+    h = jnp.sum(payload * weights, dtype=jnp.uint32)
+    set_idx = (h % jnp.uint32(S)).astype(jnp.int32)
+
+    way_cfg = memo["key_cfg"][set_idx]          # [W]
+    way_groups = memo["key_groups"][set_idx]    # [W, N]
+    way_times = memo["key_times"][set_idx]      # [W, M]
+    eq = ((way_cfg == cfg)
+          & jnp.all(way_groups == groups[None], axis=-1)
+          & jnp.all(_bits(way_times) == _bits(times)[None],
+                    axis=tuple(range(1, _bits(way_times).ndim))))
+    hit = eq.any()
+    way_hit = jnp.argmax(eq).astype(jnp.int32)
+
+    t, ok = jax.lax.cond(
+        hit,
+        lambda _: (memo["val_t"][set_idx, way_hit],
+                   memo["val_ok"][set_idx, way_hit]),
+        lambda _: compute(),
+        operand=None)
+
+    # miss insert: round-robin way per set; the write is a pair of
+    # where-gated dynamic-update-slices, cheap either way (and dead on
+    # the hit path only in the sense that it rewrites identical state)
+    way_ins = memo["rr"][set_idx] % jnp.int32(W)
+    miss = ~hit
+    evict = miss & (memo["key_cfg"][set_idx, way_ins] >= 0)
+
+    def upd(arr, val):
+        old = arr[set_idx, way_ins]
+        return arr.at[set_idx, way_ins].set(jnp.where(miss, val, old))
+
+    memo = {
+        "key_cfg": upd(memo["key_cfg"], cfg),
+        "key_groups": upd(memo["key_groups"], groups),
+        "key_times": upd(memo["key_times"], times),
+        "val_t": upd(memo["val_t"], t),
+        "val_ok": upd(memo["val_ok"], ok),
+        "rr": memo["rr"].at[set_idx].add(miss.astype(jnp.int32)),
+        "hits": memo["hits"] + hit.astype(jnp.int32),
+        "misses": memo["misses"] + miss.astype(jnp.int32),
+        "evicts": memo["evicts"] + evict.astype(jnp.int32),
+    }
+    return (t, ok), memo
+
+
+def memo_trace_counters(memo: dict) -> dict:
+    """The per-step cumulative counter snapshot the segment/episode
+    kernels trace under :data:`MEMO_TRACE_KEYS` order."""
+    return {"memo_hits": memo["hits"], "memo_misses": memo["misses"],
+            "memo_evicts": memo["evicts"]}
+
+
+def summarize_counters(memo: dict) -> dict:
+    """{hits, misses, evicts, hit_rate} from a carried (possibly
+    lane-stacked) memo state — the ONE summary home shared by
+    `DevicePPOCollector.memo_counters` and
+    `FusedEpochDriver.memo_counters`. One explicit device fetch of three
+    small arrays; call at drain/reporting boundaries only (bench JSON,
+    logging), never on a per-collect/per-epoch hot path."""
+    import jax
+
+    vals = jax.device_get({k: memo[k]
+                           for k in ("hits", "misses", "evicts")})
+    out = {k: int(np.sum(v)) for k, v in vals.items()}
+    total = out["hits"] + out["misses"]
+    out["hit_rate"] = out["hits"] / total if total else 0.0
+    return out

@@ -48,9 +48,12 @@ _RLLIB_TO_PPO = {
 # algo_config keys consumed by the epoch loops themselves rather than the
 # per-algorithm translators (num_workers sizes the vectorised env pool;
 # device_collector flips PPO collection to the jitted in-kernel env,
-# device_bank_jobs sizes its per-lane sampled job banks)
+# device_bank_jobs sizes its per-lane sampled job banks,
+# use_jax_lookahead_memo gates the in-kernel lookahead memo:
+# "auto" (default) = on for single-lane collection only, True/False
+# force it — sim/jax_memo.py)
 _LOOP_LEVEL_ALGO_KEYS = {"num_workers", "device_collector",
-                         "device_bank_jobs"}
+                         "device_bank_jobs", "use_jax_lookahead_memo"}
 
 
 def _reject_unknown_algo_keys(algo_name: str, keys, known) -> None:
@@ -351,6 +354,23 @@ class RLEpochLoop:
             # the same template-env/bank setup as device_collector
             self.device_collector = True
         self.device_bank_jobs = (algo_config or {}).get("device_bank_jobs")
+        # the in-kernel lookahead memo knob (ISSUE 13, sim/jax_memo.py):
+        # "auto" resolves to ON only for lanes=1 collection (where the
+        # probe's lax.cond short-circuits; under multi-lane vmap the
+        # cond lowers to select and the memo is inert)
+        self.use_jax_lookahead_memo = (algo_config or {}).get(
+            "use_jax_lookahead_memo", "auto")
+        if (self.use_jax_lookahead_memo != "auto"
+                and not self.device_collector):
+            # loud-rejection convention (as pipeline_depth /
+            # device_collector on DQN/ES): a forced value on a path that
+            # never consults it would silently no-op while the user
+            # believes the memo is active in their comparison runs
+            raise ValueError(
+                "use_jax_lookahead_memo is an in-kernel-collection knob "
+                "(sim/jax_memo.py): it needs algo_config."
+                "device_collector=true or loop_mode='fused' — remove it "
+                "or leave it 'auto' for host collection")
 
         # Multi-host: each process must collect DIFFERENT rollouts (its
         # shard of the global batch), so env seeds and the action-sampling
@@ -494,7 +514,8 @@ class RLEpochLoop:
                 et, ot, self.model,
                 self._stacked_banks(et, env0, lanes), segment_len,
                 self.updates_per_epoch, train_step_fn=step_fn,
-                state_shardings=state_shardings, mesh=self.mesh)
+                state_shardings=state_shardings, mesh=self.mesh,
+                memo_cfg=self._memo_knob())
 
         # own the chip for the probing AND the whole training run (the
         # documented wedge gotcha: a probe loop opening a second axon
@@ -529,7 +550,8 @@ class RLEpochLoop:
                 signature_extra=(f"{type(self.learner).__name__}|"
                                  f"{self.model!r}"),
                 lanes=cfg.get("lanes"),
-                segment_len=cfg.get("segment_len"))
+                segment_len=cfg.get("segment_len"),
+                memo_cfg=self._memo_knob())
         except BaseException:
             if self._chip_lock is not None:
                 self._chip_lock.__exit__()
@@ -552,6 +574,18 @@ class RLEpochLoop:
             self.loop_mode = "pipelined"
             return
         self.fused = driver
+
+    def _memo_knob(self):
+        """The ``use_jax_lookahead_memo`` algo key as the value the
+        collectors' ``resolve_memo_cfg`` consumes: "auto" passes
+        through (per-build lane-count resolution), True/False force a
+        MemoConfig/None."""
+        from ddls_tpu.sim.jax_memo import MemoConfig
+
+        knob = self.use_jax_lookahead_memo
+        if knob == "auto":
+            return "auto"
+        return MemoConfig() if knob else None
 
     def _device_tables(self):
         """Static jitted-env tables from the template env (shared by the
@@ -637,7 +671,8 @@ class RLEpochLoop:
         return DevicePPOCollector(et, ot, self.model, stacked,
                                   self.rollout_length,
                                   mesh=self._collection_mesh(
-                                      self.num_envs))
+                                      self.num_envs),
+                                  memo_cfg=self._memo_knob())
 
     # ----------------------------------------------------------------- epoch
     def _split_rng(self):

@@ -60,7 +60,10 @@ def mode_width():
     from ddls_tpu.sim.jax_env import make_episode_fn
 
     env, et, mk_bank = build(8)
-    episode_fn = make_episode_fn(et)
+    # memo off: this experiment vmaps the kernel over widths, where the
+    # memo probe's lax.cond lowers to select and would only add dead
+    # overhead to the width scaling being measured (sim/jax_memo.py)
+    episode_fn = make_episode_fn(et, memo_cfg=None)
     rng = np.random.RandomState(0)
     D = 400
     actions = jnp.asarray(rng.choice([0, 1, 2, 4, 8], size=D), jnp.int32)

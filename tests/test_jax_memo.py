@@ -1,0 +1,361 @@
+"""In-kernel lookahead memo (sim/jax_memo.py, ISSUE 13).
+
+Unit level: a forced hash collision must MISS (bitwise residual compare)
+and recompute — never serve the colliding entry; eviction is
+deterministic round-robin; the canonical grouping matches the host's
+``np.unique``-based canonicalisation (cluster.py:468-476).
+
+Kernel level: a memo-enabled segment is BITWISE identical to a memo-off
+segment (traces, bootstrap fields) — the hit==recompute contract — the
+table persists across in-kernel episode resets exactly like the host
+``lookahead_cache`` persists across ``reset()`` (misses stop growing
+once the first episode has populated the table), and the hit rate on a
+repeated-placement episode is strictly positive. The x64 leg of the
+hit==recompute contract rides the EXISTING full-episode parity suites
+(test_jax_episode / test_jax_policy_episode run the single-lane episode
+kernels with the memo enabled by default and pin them against the host
+simulator exactly).
+
+Loop level: a lanes=1 fused epoch loop resolves the memo ON by default,
+stays transfer-free in steady state under ``jax.transfer_guard``, and
+reports counters at the drain boundary only.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# ============================================================ unit level
+class _EtStub:
+    """Minimal et for memo_init: pads + the dtype-bearing table."""
+
+    def __init__(self, n_ops=4, n_deps=6):
+        import types
+
+        self.pads = types.SimpleNamespace(n_ops=n_ops, n_deps=n_deps)
+        self.tables = {"dep_size": np.zeros(n_deps, np.float32)}
+
+
+def _key(seed, n_ops=4, n_deps=6):
+    import jax.numpy as jnp
+
+    r = np.random.RandomState(seed)
+    groups = jnp.asarray(r.randint(0, 3, n_ops), jnp.int32)
+    times = jnp.asarray(r.rand(n_deps), jnp.float32)
+    return jnp.int32(0), groups, times
+
+
+def _probe(memo, key, value):
+    from ddls_tpu.sim.jax_memo import memo_lookahead
+
+    import jax.numpy as jnp
+
+    (t, ok), memo = memo_lookahead(
+        memo, *key, lambda: (jnp.float32(value), jnp.bool_(True)))
+    return float(t), memo
+
+
+def test_forced_hash_collision_recomputes_never_serves_colliding_entry():
+    from ddls_tpu.sim.jax_memo import MemoConfig, memo_init
+
+    et = _EtStub()
+    # ONE set, ONE way: every distinct key collides by construction
+    memo = memo_init(et, MemoConfig(n_sets=1, n_ways=1))
+    a, b = _key(1), _key(2)
+    t, memo = _probe(memo, a, 1.5)      # miss: insert A
+    assert t == 1.5
+    t, memo = _probe(memo, b, 2.5)      # collides with A's set/way
+    assert t == 2.5, "collision served the colliding entry's value"
+    assert int(memo["misses"]) == 2 and int(memo["hits"]) == 0
+    assert int(memo["evicts"]) == 1     # B evicted A (1-way set)
+    t, memo = _probe(memo, b, 9.5)      # B now resident: hit serves 2.5
+    assert t == 2.5
+    assert int(memo["hits"]) == 1
+    t, memo = _probe(memo, a, 7.25)     # A was evicted: recompute
+    assert t == 7.25
+
+
+def test_eviction_is_deterministic_round_robin():
+    import jax
+
+    from ddls_tpu.sim.jax_memo import MemoConfig, memo_init
+
+    et = _EtStub()
+    keys = [_key(s) for s in (1, 2, 3)]
+
+    def drive():
+        memo = memo_init(et, MemoConfig(n_sets=1, n_ways=2))
+        for i, k in enumerate(keys):
+            _, memo = _probe(memo, k, float(i))
+        return memo
+
+    m1, m2 = drive(), drive()
+    # identical decision stream -> bit-identical table (incl. rr state)
+    for k in m1:
+        assert np.array_equal(np.asarray(m1[k]), np.asarray(m2[k])), k
+    # key 3 evicted way 0 (round-robin): key 1 misses, keys 2/3 hit
+    memo = m1
+    t, memo = _probe(memo, keys[1], 8.0)
+    assert t == 1.0  # hit: stored value
+    t, memo = _probe(memo, keys[2], 8.0)
+    assert t == 2.0  # hit: stored value
+    t, memo = _probe(memo, keys[0], 8.0)
+    assert t == 8.0  # evicted: recompute
+    del jax
+
+
+def test_zero_vs_negative_zero_times_never_alias():
+    import jax.numpy as jnp
+
+    from ddls_tpu.sim.jax_memo import MemoConfig, memo_init
+
+    et = _EtStub()
+    memo = memo_init(et, MemoConfig(n_sets=1, n_ways=2))
+    cfg, groups, _ = _key(1)
+    tz = jnp.zeros(6, jnp.float32)
+    t, memo = _probe(memo, (cfg, groups, tz), 1.0)
+    # -0.0 == 0.0 under float ==, but the probe compares BIT patterns
+    t, memo = _probe(memo, (cfg, groups, -tz), 2.0)
+    assert t == 2.0 and int(memo["hits"]) == 0
+
+
+def test_canonical_groups_matches_host_canonicalisation():
+    import jax.numpy as jnp
+
+    from ddls_tpu.sim.jax_memo import canonical_groups
+
+    r = np.random.RandomState(7)
+    for _ in range(20):
+        n = int(r.randint(1, 12))
+        sc = r.randint(0, 5, n)
+        n_valid = int(r.randint(1, n + 1))
+        valid = np.zeros(n, bool)
+        valid[:n_valid] = True
+        # the host's vectorised first-appearance renumbering
+        # (cluster.py:468-476) over the valid prefix
+        _, first_idx, inv = np.unique(sc[:n_valid], return_index=True,
+                                      return_inverse=True)
+        rank = np.argsort(np.argsort(first_idx))
+        want = np.full(n, -1, np.int32)
+        want[:n_valid] = rank[inv]
+        got = np.asarray(canonical_groups(jnp.asarray(sc, jnp.int32),
+                                          jnp.asarray(valid)))
+        assert np.array_equal(got, want), (sc, valid, got, want)
+
+
+def test_memo_knob_rejected_loudly_without_device_collection():
+    """Forcing the knob on a host-collection loop must fail before any
+    env construction (the loud-rejection convention: a silent no-op
+    would let a memo-off run masquerade as memo-on in comparisons)."""
+    from ddls_tpu.train import make_epoch_loop
+
+    with pytest.raises(ValueError, match="use_jax_lookahead_memo"):
+        make_epoch_loop("ppo", path_to_env_cls=ENV_CLS, env_config={},
+                        algo_config={"use_jax_lookahead_memo": True})
+
+
+def test_resolve_memo_cfg_knob():
+    from ddls_tpu.sim.jax_memo import MemoConfig, resolve_memo_cfg
+
+    assert resolve_memo_cfg("auto", 1) == MemoConfig()
+    assert resolve_memo_cfg("auto", 8) is None
+    assert resolve_memo_cfg(None, 1) is None
+    cfg = MemoConfig(n_sets=4, n_ways=1)
+    assert resolve_memo_cfg(cfg, 8) is cfg
+    with pytest.raises(ValueError, match="memo_cfg"):
+        resolve_memo_cfg(True, 1)
+
+
+# ========================================================== kernel level
+ENV_CLS = "ddls_tpu.envs.partitioning_env.RampJobPartitioningEnvironment"
+
+_TINY_MODEL = {"fcnet_hiddens": [16],
+               "custom_model_config": {"out_features_msg": 4,
+                                       "out_features_hidden": 8,
+                                       "out_features_node": 4,
+                                       "out_features_graph": 4}}
+
+
+@pytest.fixture(scope="module")
+def memo_env(tmp_path_factory):
+    """Small canonical env + tables + tiny policy, shared by the kernel-
+    and loop-level tests (one dataset, one table build)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+    from ddls_tpu.models.policy import GNNPolicy
+    from ddls_tpu.sim.jax_env import (build_episode_tables,
+                                      build_job_bank, build_obs_tables)
+
+    d = str(tmp_path_factory.mktemp("memo_jobs"))
+    generate_pipedream_txt_files(d, n_cnn=1, n_translation=1, seed=9,
+                                 min_ops=4, max_ops=6)
+    env_config = dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2, "num_channels": 1,
+            "total_node_bandwidth": 1.6e12,
+            "intra_gpu_propagation_latency": 50e-9,
+            "worker_io_latency": 100e-9}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={"path_to_files": d,
+                     "job_interarrival_time_dist": {
+                         "_target_":
+                             "ddls_tpu.demands.distributions.Fixed",
+                         "val": 60.0},
+                     "max_acceptable_job_completion_time_frac_dist": {
+                         "_target_":
+                             "ddls_tpu.demands.distributions.Uniform",
+                         "min_val": 0.2, "max_val": 1.0, "decimals": 2},
+                     "replication_factor": 10,
+                     "job_sampling_mode": "remove_and_repeat",
+                     "num_training_steps": 10},
+        max_partitions_per_op=4, min_op_run_time_quantum=0.01,
+        reward_function="job_acceptance", max_simulation_run_time=6e2,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+    env = RampJobPartitioningEnvironment(**env_config)
+    obs0 = env.reset(seed=0)
+    et = build_episode_tables(env)
+    ot = build_obs_tables(env, et)
+    model = GNNPolicy(n_actions=5, out_features_msg=4,
+                      out_features_hidden=8, out_features_node=4,
+                      out_features_graph=4, fcnet_hiddens=(16,))
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.tree_util.tree_map(jnp.asarray, obs0))
+    r = np.random.RandomState(0)
+    recs = [{"model": et.types[int(r.randint(0, len(et.types)))],
+             "num_training_steps": 10,
+             "sla_frac": round(float(r.uniform(0.2, 1.0)), 2),
+             "time_arrived": 60.0 * i} for i in range(12)]
+    bank = {k: jnp.asarray(v)
+            for k, v in build_job_bank(et, recs).items()}
+    return {"dataset": d, "env": env, "env_config": env_config,
+            "et": et, "ot": ot, "model": model, "params": params,
+            "bank": bank}
+
+
+def test_segment_memo_bitwise_parity_and_cross_reset_persistence(
+        memo_env):
+    """The load-bearing kernel pin: memo-on == memo-off BITWISE across
+    three carried segments spanning multiple in-kernel episode resets;
+    the memo persists across those resets (misses FREEZE once the first
+    episode populated the table — the host lookahead_cache contract),
+    and the repeated-placement hit rate is > 0."""
+    import jax
+
+    from ddls_tpu.sim.jax_env import make_segment_fn, segment_init
+    from ddls_tpu.sim.jax_memo import MemoConfig
+
+    et, ot = memo_env["et"], memo_env["ot"]
+    model, params, bank = (memo_env["model"], memo_env["params"],
+                           memo_env["bank"])
+    mc = MemoConfig(n_sets=16, n_ways=2)
+    seg_on = make_segment_fn(et, ot, model, 24, memo_cfg=mc)
+    seg_off = make_segment_fn(et, ot, model, 24)
+    st_on = segment_init(et, bank, mc)
+    st_off = segment_init(et, bank)
+    rng = jax.random.PRNGKey(7)
+    dones = 0
+    miss_curve, hit_curve = [], []
+    for _ in range(3):
+        rng, sub = jax.random.split(rng)
+        st_on, tr_on, nf_on = seg_on(bank, params, st_on, sub)
+        st_off, tr_off, nf_off = seg_off(bank, params, st_off, sub)
+        for k in tr_off:  # identical actions/rewards/counters/fields
+            assert np.array_equal(np.asarray(tr_on[k]),
+                                  np.asarray(tr_off[k])), k
+        for k in nf_off:  # identical bootstrap fields
+            assert np.array_equal(np.asarray(nf_on[k]),
+                                  np.asarray(nf_off[k])), k
+        dones += int(np.asarray(tr_on["done"]).sum())
+        miss_curve.append(int(np.asarray(tr_on["memo_misses"])[-1]))
+        hit_curve.append(int(np.asarray(tr_on["memo_hits"])[-1]))
+    assert dones >= 2, "horizon must complete episodes for this pin"
+    # cross-reset persistence: every episode after the first replays
+    # bank placements already in the table — misses stop growing
+    assert miss_curve[1] == miss_curve[0] == miss_curve[2], miss_curve
+    # repeated-placement hit rate > 0 (ISSUE 13 satellite)
+    assert hit_curve[-1] > 0
+    assert hit_curve[-1] / (hit_curve[-1] + miss_curve[-1]) > 0.5
+
+
+def test_device_collector_resolves_memo_by_lanes_and_reports(memo_env):
+    """num_envs=1 -> memo auto-ON with counters at the drain boundary;
+    num_envs>1 -> auto-OFF (vmap select hazard) and counters None."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddls_tpu.rl.ppo_device import DevicePPOCollector
+
+    et, ot = memo_env["et"], memo_env["ot"]
+    model, params, bank = (memo_env["model"], memo_env["params"],
+                           memo_env["bank"])
+    one = {k: v[None] for k, v in bank.items()}
+    col = DevicePPOCollector(et, ot, model, one, rollout_length=24)
+    assert col.memo_cfg is not None
+    for seed in (3, 4):
+        out = col.collect(params, jax.random.PRNGKey(seed))
+    assert out["traj"]["actions"].shape == (24, 1)
+    counters = col.memo_counters()
+    assert counters is not None and counters["hits"] > 0
+    assert 0.0 < counters["hit_rate"] <= 1.0
+    # one probe per decision whose action enters the heavy path
+    # (action-0 decisions skip eval_cfg entirely), never more
+    assert 0 < (counters["hits"] + counters["misses"]) <= 48
+
+    two = {k: jnp.stack([v, v]) for k, v in bank.items()}
+    col2 = DevicePPOCollector(et, ot, model, two, rollout_length=4)
+    assert col2.memo_cfg is None
+    assert col2.memo_counters() is None
+
+
+def test_fused_lanes1_memo_on_transfer_free_then_reports(memo_env,
+                                                         monkeypatch):
+    """The fused loop at lanes=1 (the axon-preferred shape) resolves the
+    memo ON, its steady-state epoch stays transfer-free under
+    ``jax.transfer_guard`` (ISSUE 13 acceptance), and the bench-facing
+    counters surface only at the reporting boundary."""
+    import jax
+
+    from ddls_tpu.train import make_epoch_loop
+
+    monkeypatch.setenv("DDLS_TPU_PROBE_DIR", os.path.join(
+        memo_env["dataset"], "probe"))
+    loop = make_epoch_loop(
+        "ppo",
+        path_to_env_cls=ENV_CLS,
+        env_config=memo_env["env_config"],
+        model=_TINY_MODEL,
+        algo_config={"train_batch_size": 16, "sgd_minibatch_size": 8,
+                     "num_sgd_iter": 1, "num_workers": 1},
+        num_envs=1, rollout_length=16, n_devices=1,
+        use_parallel_envs=False, evaluation_interval=None, seed=0,
+        loop_mode="fused", updates_per_epoch=1,
+        metrics_sync_interval=3,
+        fused_config={"lanes": 1, "segment_len": 16})
+    try:
+        assert loop.fused is not None, "fused build fell back"
+        assert loop.fused.memo_cfg is not None, (
+            "lanes=1 fused must resolve the memo ON by default")
+        loop.run()  # warm: compile + first-use constant transfers
+        with jax.transfer_guard("disallow"):
+            loop.run()  # steady state: memo table stays on device
+        r3 = loop.run()  # drain boundary
+        assert np.isfinite(r3["learner"]["total_loss"])
+        counters = loop.fused.memo_counters()
+        assert counters is not None
+        # one probe per heavy-path decision across 3 epochs x 16 steps
+        assert 0 < counters["hits"] + counters["misses"] <= 3 * 16
+        assert counters["hits"] > 0
+    finally:
+        loop.close()

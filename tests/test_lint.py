@@ -616,7 +616,13 @@ def test_frozen_param_tree_suppressed(tmp_path):
 # ------------------------------------------------ backend-surface-parity
 def parity_files(jax_env_extra="", host_strings=("'queue_full'",
                                                  "'mounted'"),
-                 ppo_extra="", harvest_keys=("'env_index'", "'ret'")):
+                 ppo_extra="", harvest_keys=("'env_index'", "'ret'"),
+                 host_key_fns=("lookahead_key_for",
+                               "_assemble_lookahead_key"),
+                 memo_surface=("'lookahead_key_for'",
+                               "'_assemble_lookahead_key'"),
+                 memo_trace_keys=("'memo_hits'",),
+                 memo_extra=""):
     jax_env = (
         "CAUSE_QUEUE_FULL = 0\n"
         "CAUSE_MOUNTED = 1\n"
@@ -624,8 +630,9 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
         "CAUSE_MOUNTED: 'mounted'}\n"
         + jax_env_extra +
         "def make_segment_fn():\n"
-        "    trace = {'ep_ret': 0, 'action': 1}\n")
-    host = "HOST_CAUSES = (" + ", ".join(host_strings) + ")\n"
+        "    trace = {'ep_ret': 0, 'action': 1, 'memo_hits': 2}\n")
+    host = ("HOST_CAUSES = (" + ", ".join(host_strings) + ")\n"
+            + "".join(f"def {fn}():\n    pass\n" for fn in host_key_fns))
     ppo = ("def collect(trace):\n"
            "    r = trace['ep_ret']\n"
            + ppo_extra +
@@ -633,13 +640,17 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
            "    return [{" + ": 1, ".join(harvest_keys) + ": 2}]\n")
     rollout = ("def harvest_episode_record(env):\n"
                "    return {'env_index': 0, 'ret': 1.0}\n")
+    memo = ("HOST_KEY_SURFACE = (" + ", ".join(memo_surface) + ",)\n"
+            "MEMO_TRACE_KEYS = (" + ", ".join(memo_trace_keys) + ",)\n"
+            + memo_extra)
     return {"jax_env.py": jax_env, "cluster.py": host, "ppo.py": ppo,
-            "rollout.py": rollout}
+            "rollout.py": rollout, "jax_memo.py": memo}
 
 
 PARITY_CFG = {"backend-surface-parity": {
     "jax_env": "jax_env.py", "ppo_device": "ppo.py",
-    "rollout": "rollout.py", "host_cause_files": ["cluster.py"],
+    "rollout": "rollout.py", "jax_memo": "jax_memo.py",
+    "host_cause_files": ["cluster.py"],
     "jitted_only_causes": []}}
 
 
@@ -706,6 +717,60 @@ def test_backend_parity_missing_host_file_is_flagged(tmp_path):
     # and the half-vocabulary drift compare is skipped (no noise)
     assert not any("drifted" in f.message
                    for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_memo_missing_host_key_builder_fires(tmp_path):
+    # the memo mirrors the host memo-key builders (ISSUE 13): renaming
+    # one in cluster.py without updating the in-kernel mirror must fail
+    # at lint time, not at the first stale-memo debugging session
+    files = parity_files(host_key_fns=("lookahead_key_for",))
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("'_assemble_lookahead_key'" in f.message
+               and "host memo-key builders moved" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_memo_untraced_counter_key_fires(tmp_path):
+    files = parity_files(memo_trace_keys=("'memo_hits'",
+                                          "'memo_evictions'"))
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("'memo_evictions'" in f.message
+               and "would not drain" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_memo_counter_via_emitter_is_clean(tmp_path):
+    # the real tree's shape: make_segment_fn emits the counters through
+    # jax_memo.memo_trace_counters (one naming home) — keys literal in
+    # that helper count as traced
+    files = parity_files(
+        memo_trace_keys=("'memo_hits'", "'memo_misses'"),
+        memo_extra=("def memo_trace_counters(memo):\n"
+                    "    return {'memo_misses': memo}\n"))
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert res.errors == []
+
+
+def test_backend_parity_memo_surface_moved_fires(tmp_path):
+    files = parity_files()
+    files["jax_memo.py"] = "def memo_init():\n    pass\n"
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    msgs = [f.message for f in errors_of(res, "backend-surface-parity")]
+    assert any("HOST_KEY_SURFACE" in m and "moved" in m for m in msgs)
+    assert any("MEMO_TRACE_KEYS" in m and "moved" in m for m in msgs)
+
+
+def test_backend_parity_missing_memo_file_is_flagged(tmp_path):
+    files = parity_files()
+    del files["jax_memo.py"]
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("cannot read 'jax_memo.py'" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
 
 
 def test_backend_parity_suppressed(tmp_path):
