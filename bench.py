@@ -657,6 +657,201 @@ def run_collect_bench(args) -> dict:
     return payload
 
 
+#: bench model for the impala depth A/B: small enough that a CPU update
+#: completes in ~tens of ms (the A/B measures the LOOP schedule, not the
+#: GNN), same shape vocabulary as the training configs
+_IMPALA_BENCH_MODEL = {
+    "fcnet_hiddens": [64],
+    "custom_model_config": {"out_features_msg": 8,
+                            "out_features_hidden": 16,
+                            "out_features_node": 8,
+                            "out_features_graph": 8},
+}
+
+
+def _impala_bench_env_kwargs(args, dataset_dir: str) -> dict:
+    """The depth A/B env: same transport-isolating shape as collect mode
+    (light topology + the reference 150-node pad by default) so the
+    loop-schedule and transport terms are a measurable fraction of the
+    epoch wall instead of canonical-sim noise."""
+    kwargs = make_env_kwargs(dataset_dir)
+    if args.collect_pad_nodes:
+        kwargs["pad_obs_kwargs"] = {"max_nodes": args.collect_pad_nodes,
+                                    "max_edges": args.collect_pad_edges}
+    if args.impala_topology == "light":
+        kwargs["topology_config"]["kwargs"].update(
+            num_communication_groups=2,
+            num_racks_per_communication_group=2,
+            num_servers_per_rack=2)
+        kwargs["node_config"] = {"type_1": {
+            "num_nodes": 8,
+            "workers_config": [{"num_workers": 1, "worker": "A100"}]}}
+        kwargs["jobs_config"]["num_training_steps"] = 2
+        kwargs["max_simulation_run_time"] = 5e4
+    return kwargs
+
+
+def run_impala_depth_bench(args) -> dict:
+    """Interleaved same-process depth A/B of the IMPALA pipelined loop
+    (ISSUE 15): one epoch loop per pipeline depth — 0, 1, and
+    ``--pipeline-depth`` (K) — stepping identically-configured envs on
+    the same seeds, timed in paired rounds with the lead rotating, the
+    headline taken from the depth-K loop's median round rate and the
+    comparison from the MEDIAN of paired per-round ratios (the
+    collect/fused drift-control protocol). Depth 1 runs the LEGACY
+    single-slab transport (``ring_segments=0`` — today's path, bulk
+    defensive copy included) so ``depth_speedup_vs_depth1`` is
+    ring-vs-incumbent, not ring-vs-ring; depths 0 and K ride the
+    trajectory ring.
+
+    Round walls are self-contained: each timed round ends only after
+    the loop's dispatched updates AND its in-flight background
+    collections settle, so a deeper queue can neither bleed CPU into a
+    neighbour's round nor bank untimed work for its own next one —
+    prefetched batches consumed at a round's start were paid for at the
+    previous round's end, cancelling in the median over rounds.
+
+    The `ring` block (segments/leases/stalls/mean params-age) is
+    fetched ONCE from the depth-K loop at this reporting boundary —
+    host ints off the ledger, never a device fetch (the PR 9 memo-block
+    discipline)."""
+    import jax
+
+    from ddls_tpu.rl.shm import shm_available
+    from ddls_tpu.train import make_epoch_loop
+
+    dataset_dir = _make_dataset()
+    env_kwargs = _impala_bench_env_kwargs(args, dataset_dir)
+    B = args.num_envs
+    T = args.rollout_length
+    K = max(int(args.pipeline_depth), 2)
+    depths = [0, 1, K]
+    # the A/B is about the ring transport: subprocess workers + shm are
+    # forced wherever POSIX shm exists, even on a 1-core box (the arms
+    # timeshare identically, so the paired ratios stay fair); without
+    # shm every depth falls back to in-process envs and the comparison
+    # degrades to pure loop scheduling (flagged in the JSON line)
+    use_parallel = shm_available() or _available_cores() > 1
+
+    def make_loop(depth):
+        loop = make_epoch_loop(
+            "impala",
+            path_to_env_cls="ddls_tpu.envs.partitioning_env."
+                            "RampJobPartitioningEnvironment",
+            env_config=env_kwargs,
+            model=_IMPALA_BENCH_MODEL,
+            algo_config={"train_batch_size": B * T, "num_workers": B},
+            num_envs=B, rollout_length=T,
+            n_devices=len(jax.devices()),
+            use_parallel_envs=use_parallel,
+            vec_env_backend=args.vec_backend,
+            evaluation_interval=None, seed=0, loop_mode="pipelined",
+            pipeline_depth=depth,
+            metrics_sync_interval=1_000_000)
+        if depth == 1:
+            # today's depth-1 incumbent: single slab + bulk copy
+            loop.collector.ring_segments = 0
+        return loop
+
+    loops = {d: make_loop(d) for d in depths}
+
+    def settle(loop):
+        """End-of-round sync: dispatched updates complete and the
+        background queue drains, so the round wall owns ALL the work
+        it scheduled (see docstring)."""
+        jax.block_until_ready(loop.state.params)
+        for future, _ in loop._collect_futures:
+            future.result()
+
+    telemetry.enable()
+    warm = max(args.warmup_epochs, K + 2)  # per-segment alias probes
+    with telemetry.span("bench.warmup"):
+        for loop in loops.values():
+            for _ in range(warm):
+                loop.run()
+            settle(loop)
+
+    rounds = args.collect_rounds
+    k_epochs = max(args.timed_epochs, 2)
+    acc = {d: {"steps": 0, "wall": 0.0, "rates": []} for d in depths}
+    bench_start = time.perf_counter()
+    completed_rounds = 0
+    for r in range(rounds):
+        if time.perf_counter() - bench_start > 0.8 * args.budget_seconds:
+            break  # a JSON line must land inside the driver's budget
+        order = depths if r % 2 else list(reversed(depths))
+        for d in order:
+            loop = loops[d]
+            steps = 0
+            with telemetry.span(f"bench.run_depth{d}") as span:
+                for _ in range(k_epochs):
+                    steps += loop.run()["env_steps_this_iter"]
+                settle(loop)
+            a = acc[d]
+            a["steps"] += steps
+            a["wall"] += span.duration_s
+            a["rates"].append(steps / span.duration_s)
+        completed_rounds += 1
+    if not completed_rounds:
+        raise RuntimeError(
+            f"no timed rounds completed (collect_rounds={rounds}, "
+            f"budget_seconds={args.budget_seconds}) — nothing to report")
+
+    ring_stats = loops[K].ring_stats()
+    depth_results = {}
+    for d in depths:
+        a = acc[d]
+        rates = np.asarray(a["rates"])
+        depth_results[str(d)] = {
+            "env_steps_per_sec": round(a["steps"] / a["wall"], 2),
+            "median_round_env_steps_per_sec": round(
+                float(np.median(rates)), 2),
+            "per_round_env_steps_per_sec": [round(float(x), 2)
+                                            for x in rates],
+            "transport": ("single-slab (pre-ring incumbent)" if d == 1
+                          else "trajectory-ring"),
+            "ring": loops[d].ring_stats(),
+        }
+    for loop in loops.values():
+        loop.close()
+
+    paired_k1 = [a / b for a, b in zip(acc[K]["rates"], acc[1]["rates"])]
+    paired_10 = [a / b for a, b in zip(acc[1]["rates"], acc[0]["rates"])]
+    return {
+        "metric": "impala_env_steps_per_sec",
+        "value": depth_results[str(K)]["median_round_env_steps_per_sec"],
+        "unit": "env_steps/s",
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "platform": jax.devices()[0].platform,
+        "pipeline_depth": K,
+        "depths": depth_results,
+        # the ISSUE 15 acceptance statistic: median of paired per-round
+        # depth-K-on-ring vs depth-1-incumbent rate ratios
+        "depth_speedup_vs_depth1": round(float(np.median(paired_k1)), 3),
+        "paired_round_speedups_vs_depth1": [round(x, 3)
+                                            for x in paired_k1],
+        "depth1_speedup_vs_depth0": round(float(np.median(paired_10)), 3),
+        "ring": ({"segments": ring_stats["segments"],
+                  "leases": ring_stats["leases"],
+                  "stalls": ring_stats["stalls"],
+                  "mean_params_age": ring_stats["mean_params_age"],
+                  "occupancy_counts": ring_stats["occupancy_counts"]}
+                 if ring_stats is not None else None),
+        "topology": args.impala_topology,
+        "vec_env_backend": getattr(loops[K].vec_env, "backend", "inproc"),
+        "num_envs": B,
+        "rollout_length": T,
+        # rounds that actually RAN (the budget guard may cut the
+        # configured --collect-rounds short)
+        "timed_rounds": completed_rounds,
+        "timed_rounds_requested": rounds,
+        "epochs_per_round": k_epochs,
+        "cores": _available_cores(),
+        "telemetry": telemetry.snapshot(),
+    }
+
+
 def run_jaxenv_bench(args) -> dict:
     """Fully-jitted episode throughput (sim/jax_env.py): ONE device
     dispatch runs a whole padded episode, so the tunnelled per-step RTT
@@ -1228,8 +1423,18 @@ def run_bench(args, platform_note: str | None,
             out = collector_pipe.collect(state.params, rng)
             straj, slv = learner.shard_traj(out["traj"],
                                             out["last_values"])
+        segment = out.get("ring_segment")
+        if segment is not None:
+            # the ring consumer token protocol lives in ONE place
+            # (rl/ring.py note_staged/note_update) — bench mirrors the
+            # training loop by calling it, never by re-implementing it
+            out["ring"].note_staged(segment, straj["obs"],
+                                    generation=out.get("ring_generation"))
         t0 = telemetry.clock_now()
         state, metrics = learner.train_step(state, straj, slv, rng)
+        if segment is not None:
+            out["ring"].note_update(segment, metrics["total_loss"],
+                                    generation=out.get("ring_generation"))
 
         def watch(metrics=metrics, t0=t0):
             jax.block_until_ready(metrics)
@@ -1518,6 +1723,11 @@ def run_bench(args, platform_note: str | None,
                 memo["hit_rate"] = round(memo["hit_rate"], 4)
                 mode_results[mode]["memo"] = memo
 
+    # trajectory-ring ledger (rl/ring.py): host ints, fetched ONCE here
+    # at the reporting boundary (the PR 9 memo-block discipline) before
+    # close() drops the ring
+    traj_ring = getattr(vec, "traj_ring", None)
+    ring_stats = traj_ring.stats() if traj_ring is not None else None
     vec.close()
     if headline_mode not in mode_results:
         # budget guard skipped the headline mode's rounds: report the
@@ -1599,6 +1809,14 @@ def run_bench(args, platform_note: str | None,
         "telemetry": telemetry.snapshot(),
     }
     payload.update(payload_extra)
+    if ring_stats is not None:
+        payload["ring"] = {
+            "segments": ring_stats["segments"],
+            "leases": ring_stats["leases"],
+            "stalls": ring_stats["stalls"],
+            "mean_params_age": ring_stats["mean_params_age"],
+            "occupancy_counts": ring_stats["occupancy_counts"],
+        }
     if platform_note:
         payload["platform_note"] = platform_note
     if fused_autotune is not None and fused_driver is None:
@@ -1720,14 +1938,27 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--mode",
                         choices=("ppo", "sim", "jaxenv", "serve",
-                                 "collect"),
+                                 "collect", "impala"),
                         default="ppo",
                         help="ppo: full train loop; sim: pure env "
                              "stepping; jaxenv: fully-jitted episodes; "
                              "serve: online policy serving at offered "
                              "load (ddls_tpu/serve); collect: "
                              "interleaved pipe-vs-shm obs-transport A/B "
-                             "(rollout collection only, no learner)")
+                             "(rollout collection only, no learner); "
+                             "impala: interleaved pipeline-depth A/B of "
+                             "the IMPALA loop on the trajectory ring "
+                             "(depths 0/1/--pipeline-depth, rl/ring.py)")
+    parser.add_argument("--pipeline-depth", type=int, default=2,
+                        help="impala mode: the depth-K arm of the A/B "
+                             "(>= 2; depth 1 runs the pre-ring "
+                             "single-slab incumbent for comparison)")
+    parser.add_argument("--impala-topology",
+                        choices=("light", "canonical"), default="light",
+                        help="impala mode env (same rationale as "
+                             "--collect-topology: light makes the loop "
+                             "schedule a measurable fraction of the "
+                             "epoch wall)")
     parser.add_argument("--vec-backend", choices=("auto", "pipe", "shm"),
                         default="auto",
                         help="ppo mode's subprocess obs transport "
@@ -1967,6 +2198,28 @@ def _dispatch_mode(args, process_start: float) -> int:
         except Exception:
             tb = traceback.format_exc().strip().splitlines()
             emit({"metric": "collect_env_steps_per_sec", "value": None,
+                  "unit": "env_steps/s", "vs_baseline": None,
+                  "error": " | ".join(tb[-3:])})
+            return 1
+
+    if args.mode == "impala":
+        # loop-schedule A/B on the CPU backend (the tunnelled TPU's
+        # wedge risk buys nothing here — the depths differ in HOST
+        # schedule; the chip-bound story is open item 1's dispatch
+        # amortisation). Unlike sim/collect this mode RUNS jitted
+        # updates, so the env var alone is not enough — the axon
+        # sitecustomize imports jax at interpreter start (CLAUDE.md)
+        # and only jax.config.update reliably pins the platform
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            emit(run_impala_depth_bench(args))
+            return 0
+        except Exception:
+            tb = traceback.format_exc().strip().splitlines()
+            emit({"metric": "impala_env_steps_per_sec", "value": None,
                   "unit": "env_steps/s", "vs_baseline": None,
                   "error": " | ".join(tb[-3:])})
             return 1

@@ -36,6 +36,7 @@ DEFAULT_MODULES = (
     "ddls_tpu/rl/rollout.py",
     "ddls_tpu/rl/ppo_device.py",
     "ddls_tpu/rl/shm.py",
+    "ddls_tpu/rl/ring.py",
     "ddls_tpu/rl/fused.py",
     # the in-kernel lookahead memo rides the carried device state of
     # every collect; an implicit coercion here would fetch the table (or

@@ -2,9 +2,10 @@ from ddls_tpu.rl.dqn import (ApexDQNLearner, DQNConfig,
                              PrioritizedReplayBuffer, nstep_transitions,
                              per_worker_epsilons)
 from ddls_tpu.rl.ppo import PPOConfig, PPOLearner, compute_gae
+from ddls_tpu.rl.ring import TrajRing
 from ddls_tpu.rl.rollout import ParallelVectorEnv, RolloutCollector, VectorEnv
 
 __all__ = ["ApexDQNLearner", "DQNConfig", "PrioritizedReplayBuffer",
            "nstep_transitions", "per_worker_epsilons",
            "PPOConfig", "PPOLearner", "compute_gae", "ParallelVectorEnv",
-           "RolloutCollector", "VectorEnv"]
+           "RolloutCollector", "TrajRing", "VectorEnv"]

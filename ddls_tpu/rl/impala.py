@@ -4,12 +4,19 @@ Replaces the reference's RLlib ``ImpalaTrainer``
 (scripts/ramp_job_partitioning_configs/algo/impala.yaml;
 rllib_epoch_loop.py:34 trains it through the same epoch loop as PPO). The
 reference's IMPALA decouples actors from the learner with Ray queues; here
-the decoupling that matters is *statistical*, not infrastructural -- the
-vectorised collector's sampling policy lags the learner by up to one epoch,
-and V-trace importance weighting (Espeholt et al. 2018, arXiv 1802.01561)
-corrects exactly that lag. The update itself is one jitted SPMD program:
-trajectories sharded over the mesh's ``dp`` axis, parameters replicated,
-gradient all-reduce emitted by XLA.
+the decoupling is *statistical* first — the vectorised collector's
+sampling policy lags the learner, and V-trace importance weighting
+(Espeholt et al. 2018, arXiv 1802.01561) corrects exactly that lag — and,
+since the depth-K pipelined loop (train/loops.py ``pipeline_depth``, the
+rl/ring.py trajectory ring), infrastructural too: up to K collected
+batches ride ahead of the learner, each arriving ``params_age_updates``
+updates stale, the behavior logp travelling in the traj. The update
+itself is one jitted SPMD program: trajectories sharded over the mesh's
+``dp`` axis, parameters replicated, gradient all-reduce emitted by XLA.
+The ``mean_rho`` / ``clip_rho_fraction`` metrics make the absorbed
+staleness visible: rho drifting from 1 (and the clip engaging) is the
+signature of batches collected too many updates behind the target
+policy.
 
 Config surface follows the reference's impala.yaml: vtrace rho/pg-rho clips
 1.0, ``vtrace_drop_last_ts``, grad_clip 40, adam (``opt_type: adam``),
@@ -195,10 +202,17 @@ class ImpalaLearner:
 
         total = (policy_loss + cfg.vf_loss_coeff * vf_loss
                  - cfg.entropy_coeff * entropy)
-        mean_rho = jnp.mean(jnp.exp(target_logp[sl] - traj["logp"][sl]))
+        rho_all = jnp.exp(target_logp[sl] - traj["logp"][sl])
         metrics = {"policy_loss": policy_loss, "vf_loss": vf_loss,
                    "entropy": entropy, "total_loss": total,
-                   "mean_rho": mean_rho}
+                   "mean_rho": jnp.mean(rho_all),
+                   # fraction of importance weights the rho clip truncated
+                   # — the staleness-absorption gauge for the depth-K
+                   # pipelined loop (0 on-policy; rising values mean the
+                   # behavior policy is falling behind the target)
+                   "clip_rho_fraction": jnp.mean(
+                       (rho_all > cfg.vtrace_clip_rho_threshold)
+                       .astype(jnp.float32))}
         return total, metrics
 
     def _train_step(self, state: ImpalaState, traj, last_values):

@@ -183,10 +183,15 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
     this worker's ``[dest_row, env_index]`` slice via the masked-pad
     ``envs.obs.write_obs_into`` — the pipe reply shrinks to the
     (reward, done, record) control payload, which doubles as the ready
-    flag the parent waits on before reading the slice.
+    flag the parent waits on before reading the slice. ``ring_open``
+    upgrades the mapping to a trajectory ring (rl/ring.py): K segment
+    attachments, and ``dest_row`` becomes ``(segment, row)`` — segment
+    ownership (who may be written when) is entirely parent-side; the
+    worker just writes where the step command points.
     """
     attachment = None
-    writer = None  # set with the attachment on shm_open
+    ring_attachment = None  # set on ring_open (rl/ring.py segments)
+    writer = None  # set with the attachment on shm_open/ring_open
     try:
         if telemetry_enabled:
             telemetry.enable()
@@ -220,6 +225,25 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
                     attachment.views["node_features"].shape[2],
                     attachment.views["edge_features"].shape[2])
                 conn.send(("ok", None))
+            elif cmd == "ring_open":
+                from ddls_tpu.envs.obs import ObsWriter
+                from ddls_tpu.rl.shm import RingAttachment
+
+                if ring_attachment is not None:
+                    ring_attachment.close()
+                if attachment is not None:
+                    # retire the pre-ring slab mapping (the parent
+                    # unlinks it at first lease; keeping the mmap would
+                    # pin the memory for the worker's lifetime) — and a
+                    # stale bare-row dest after ring install now fails
+                    # loudly instead of writing a retired slab
+                    attachment.close()
+                    attachment = None
+                ring_attachment = RingAttachment(payload)
+                v0 = ring_attachment.views_for(0)
+                writer = ObsWriter(v0["node_features"].shape[2],
+                                   v0["edge_features"].shape[2])
+                conn.send(("ok", None))
             elif cmd == "step":
                 if isinstance(payload, tuple):
                     action, dest_row = payload
@@ -235,7 +259,14 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
                     seed += seed_stride
                     obs = env.reset(seed=seed)
                     episode_return, episode_length = 0.0, 0
-                if attachment is not None and dest_row is not None:
+                if isinstance(dest_row, tuple):
+                    seg, row = dest_row
+                    writer.write(obs, {k: v[row, env_index]
+                                       for k, v in
+                                       ring_attachment.views_for(
+                                           seg).items()})
+                    conn.send(("step", (float(reward), bool(done), record)))
+                elif attachment is not None and dest_row is not None:
                     writer.write(obs, {k: v[dest_row, env_index]
                                        for k, v in
                                        attachment.views.items()})
@@ -261,6 +292,8 @@ def _parallel_env_worker(conn, env_builder, env_kwargs: Dict[str, Any],
     finally:
         if attachment is not None:
             attachment.close()
+        if ring_attachment is not None:
+            ring_attachment.close()
 
 
 class _LazyObsList:
@@ -344,8 +377,13 @@ class ParallelVectorEnv:
         self._stacked_bufs: Optional[Dict[str, np.ndarray]] = None
         # shm-backend state: slabs are allocated lazily at the first
         # reset (field shapes come from a real obs), row 0 holds the
-        # current obs until ensure_traj_rows grows the slab
+        # current obs until ensure_traj_rows grows the slab — or
+        # ensure_traj_ring replaces it with a K-segment trajectory ring
+        # (rl/ring.py), after which _slabs tracks the ACTIVE segment's
+        # slab set and _active_seg its ring index (None = single slab)
         self._slabs = None
+        self._ring = None
+        self._active_seg = None
         self._field_specs = None
         self._cur_row = 0
         self._obs_list: List[Dict[str, np.ndarray]] = []
@@ -514,7 +552,26 @@ class ParallelVectorEnv:
         self._cur_row = 0
         self._obs_nbytes = slabs.obs_nbytes
 
+    def _guard_ring_write(self, what: str) -> None:
+        """Loud ledger guard shared by the parent-side write paths
+        (reset/restart row-0 writes, full-batch stepping): writing the
+        active segment while it is PUBLISHED would corrupt a batch the
+        learner may still be reading. Ready release tokens are swept
+        first, so a segment whose consumer already finished never
+        false-positives."""
+        if self._ring is None or self._active_seg is None:
+            return
+        self._ring.sweep()  # release anything whose token is ready
+        seg = self._ring.segments[self._active_seg]
+        if seg.state == "published":
+            raise RuntimeError(
+                f"{what} would write ring segment {seg.index}, which is "
+                "PUBLISHED (owned by the learner until its release "
+                "token fires) — settle the in-flight update (or release "
+                "the segment) first")
+
     def _write_row0(self, obs: List[Dict[str, np.ndarray]]) -> None:
+        self._guard_ring_write("reset/restart row-0 write")
         views = self._slabs.views
         for k in OBS_KEYS:
             for i in range(self.num_envs):
@@ -529,6 +586,16 @@ class ParallelVectorEnv:
         the pipe backend."""
         if self._slabs is None:
             return False
+        if self._ring is not None:
+            # a ring-backed env must stay on the ring: the single-slab
+            # contract would treat the ACTIVE ring segment as a private
+            # slab and rewrite rows the ledger may have handed to the
+            # learner (a silent fallback is exactly what the ring's
+            # loud-violation contract forbids)
+            raise RuntimeError(
+                "ensure_traj_rows on a ring-backed env — this env's "
+                "trajectory transport is the ring (ensure_traj_ring); "
+                "build a separate vec env for single-slab collection")
         if self._slabs.rows >= rows:
             return True
         current = self.obs  # materialise from the OLD slab first
@@ -560,6 +627,80 @@ class ParallelVectorEnv:
             views[k][0] = views[k][self._cur_row]
         self._cur_row = 0
         self._obs_cache = None
+
+    # ------------------------------------------------------ trajectory ring
+    @property
+    def traj_ring(self):
+        """The installed trajectory ring (rl/ring.py), or None."""
+        return self._ring
+
+    def ensure_traj_ring(self, rows: int, segments: int):
+        """Install (or return) a ``segments``-way trajectory ring of
+        ``[rows, B, ...]`` slabs (rl/ring.py) — the multi-segment
+        generalisation of ``ensure_traj_rows``. Returns the ring, or
+        None on the pipe backend / allocation failure (callers fall
+        back to the single-slab path). Idempotent while the requested
+        shape fits the installed ring."""
+        if self._slabs is None:
+            return None
+        if self._ring is not None:
+            if (self._ring.rows >= rows
+                    and len(self._ring.segments) >= segments):
+                return self._ring
+            # a silent fallback here would route collection onto the
+            # single-slab path while the active slab is still a ring
+            # segment the learner may own — ledger-violating writes,
+            # exactly what the contract promises can't happen. Loud by
+            # design (as ring-lease timeouts are).
+            raise RuntimeError(
+                f"trajectory ring shape change mid-run: installed "
+                f"[{self._ring.rows} rows x "
+                f"{len(self._ring.segments)} segments], requested "
+                f"[{rows} x {segments}] — build a fresh vec env for a "
+                "different rollout length or pipeline depth")
+        try:
+            from ddls_tpu.rl.ring import TrajRing
+
+            ring = TrajRing(self._field_specs, rows=rows,
+                            num_envs=self.num_envs, segments=segments)
+        except Exception as e:
+            import warnings
+
+            warnings.warn(f"could not allocate a {segments}-segment "
+                          f"trajectory ring ({e}); keeping the single "
+                          "slab")
+            return None
+        with telemetry.span("rollout.ring.setup"):
+            specs = ring.specs()
+            for i in range(self.num_envs):
+                self._send(i, ("ring_open", specs))
+            for conn in self._conns:
+                self._recv(conn)
+        self._ring = ring
+        return ring
+
+    def begin_ring_segment(self, segment) -> None:
+        """Point collection at a freshly-leased ring segment: the
+        current obs (the previous segment's bootstrap row — or the
+        pre-ring slab's current row on the first lease) is copied into
+        the new segment's row 0, the one [B, ...]-per-field copy that
+        ``rebase_row0`` pays on the single slab. The previous segment
+        is only READ here, which every ledger state permits."""
+        prev, prev_row = self._slabs, self._cur_row
+        views = segment.views
+        if prev is not segment.slabs or prev_row != 0:
+            for k in OBS_KEYS:
+                views[k][0] = prev.views[k][prev_row]
+        if self._active_seg is None and prev is not segment.slabs:
+            # first lease: the pre-ring current-obs slab is retired (its
+            # unlink frees the name now; workers' live mappings die with
+            # them — they will only ever be pointed at ring segments)
+            prev.close()
+        self._slabs = segment.slabs
+        self._active_seg = segment.index
+        self._cur_row = 0
+        self._obs_cache = None
+        self._stacked_cache = None
 
     def traj_obs_views(self, T: int) -> Dict[str, np.ndarray]:
         """Slab rows [0:T] as the trajectory obs — zero-copy views, valid
@@ -620,10 +761,23 @@ class ParallelVectorEnv:
         replies carry (reward, done, record) and arrive out of order —
         the reply is the per-worker ready flag; episode records flush in
         env-index order, matching the pipe paths bit-for-bit."""
+        if self._ring is not None and self._active_seg is None:
+            # workers retired their pre-ring slab mapping at ring_open;
+            # stepping before the first begin_ring_segment would write
+            # nowhere the parent reads — surface it, loudly
+            raise RuntimeError(
+                "trajectory ring installed but no segment is active — "
+                "lease a segment and call begin_ring_segment() before "
+                "stepping")
+        # stepping outside the lease cycle (a direct vec.step() between
+        # collects) must not rewrite a learner-owned segment either
+        self._guard_ring_write("step")
         R = self._slabs.rows
         dest = self._cur_row if R == 1 else min(self._cur_row + 1, R - 1)
+        payload_dest = (dest if self._active_seg is None
+                        else (self._active_seg, dest))
         for i in range(self.num_envs):
-            self._send(i, ("step", (int(actions[i]), dest)))
+            self._send(i, ("step", (int(actions[i]), payload_dest)))
         B = self.num_envs
         rewards = np.zeros(B, dtype=np.float32)
         dones = np.zeros(B, dtype=bool)
@@ -794,6 +948,17 @@ class ParallelVectorEnv:
             if proc.is_alive():  # terminate ignored (blocked in syscall)
                 proc.kill()
                 proc.join(timeout=1)
+        if self._ring is not None:
+            if self._active_seg is None and self._slabs is not None:
+                # ring installed but never leased: the pre-ring slab
+                # was not yet retired by begin_ring_segment — unlink it
+                # here (the parent-unlinks-on-close contract)
+                self._slabs.close()
+            # unlink every ring segment (after the first lease,
+            # self._slabs is one of them)
+            self._ring.close()
+            self._ring = None
+            self._slabs = None
         if self._slabs is not None:
             self._slabs.close()
             self._slabs = None
@@ -812,11 +977,24 @@ class RolloutCollector:
 
     def __init__(self, vec_env: VectorEnv, learner, rollout_length: int,
                  pipeline: Optional[bool] = None,
-                 deferred_fetch: bool = False):
+                 deferred_fetch: bool = False,
+                 ring_segments: Optional[int] = None):
         self.vec_env = vec_env
         self.learner = learner
         self.rollout_length = rollout_length
         B = vec_env.num_envs
+        # trajectory-ring sizing (rl/ring.py): on a shm vec env the
+        # deferred collector leases one [T+1, B, ...] segment per
+        # collect instead of rewriting the single slab, which deletes
+        # the per-segment bulk defensive copy (the PR 4 aliasing
+        # hazard is handled by segment ownership: a leased segment is
+        # not rewritten until its release token reports the staged
+        # batch consumed). None resolves to the double-buffer minimum
+        # (2) for deferred fetch; 0 forces the legacy single slab +
+        # bulk copy; the depth-K pipelined loop passes depth + 2.
+        if ring_segments is None:
+            ring_segments = 2 if deferred_fetch else 0
+        self.ring_segments = int(ring_segments)
         # deferred_fetch (the pipelined loop mode, train/loops.py): one
         # jitted program per step (rng split folded in), actions are the
         # ONLY per-step device fetch (logp/values stay device futures,
@@ -881,27 +1059,44 @@ class RolloutCollector:
         """Deferred-fetch collection (see __init__); [T, B] outputs
         bit-identical to the plain path.
 
-        On a shm-backend vec env the slabs grow to [T+1, B, ...] and the
-        workers' in-place writes ARE the trajectory buffer (row t = the
-        obs before step t, row T = the bootstrap obs): the T per-step
-        host-side copies collapse to ONE bulk memcpy of rows [0:T] into
-        a FRESH buffer at segment end. The copy is a correctness
-        requirement, not a convenience: jax's CPU client ZERO-COPY
-        ALIASES page-aligned host buffers (shm mmaps are page-aligned)
-        when a device_put/jit input needs no layout change — measured
-        here on a 1-device mesh — so slab views staged into the async
-        update would be silently rewritten by the next segment's worker
-        writes. A fresh never-rewritten buffer makes aliasing harmless
-        (jax holds the reference); the per-step sample inputs may stay
-        views because each step's ``device_get(actions)`` completes the
-        forward before any row it read is rewritten."""
+        On a shm-backend vec env the workers' in-place writes ARE the
+        trajectory buffer (row t = the obs before step t, row T = the
+        bootstrap obs). With ``ring_segments >= 2`` (the default for
+        deferred fetch) each collect leases one segment of a
+        K-segment trajectory ring (rl/ring.py) and returns ZERO-COPY
+        views of its rows: segment ownership — a published segment is
+        not rewritten until its release token reports the staged batch
+        consumed — replaces the bulk defensive copy the single slab
+        needed. That copy was a correctness requirement there: jax's
+        CPU client ZERO-COPY ALIASES page-aligned host buffers (shm
+        mmaps are page-aligned) when a device_put/jit input needs no
+        layout change — measured on a 1-device mesh — so single-slab
+        views staged into the async update would be silently rewritten
+        by the next segment's worker writes (``ring_segments=0`` keeps
+        that legacy path: slab + bulk copy). The per-step sample inputs
+        stay views on every path because each step's
+        ``device_get(actions)`` completes the forward before any row it
+        read is rewritten."""
         T, B = self.rollout_length, self.vec_env.num_envs
         step_fn = self._step_program()
-        ensure = getattr(self.vec_env, "ensure_traj_rows", None)
-        use_slab = bool(ensure is not None and ensure(T + 1))
-        if use_slab:
-            # carry the previous segment's bootstrap obs into row 0
-            self.vec_env.rebase_row0()
+        ring = segment = None
+        if self.ring_segments >= 2:
+            ensure_ring = getattr(self.vec_env, "ensure_traj_ring", None)
+            if ensure_ring is not None:
+                ring = ensure_ring(T + 1, self.ring_segments)
+        if ring is not None:
+            # lease the next free segment (counts a stall + blocks on
+            # the oldest published segment's release token when the
+            # learner is behind); its row 0 receives the bootstrap obs
+            segment = ring.lease()
+            self.vec_env.begin_ring_segment(segment)
+            use_slab = True
+        else:
+            ensure = getattr(self.vec_env, "ensure_traj_rows", None)
+            use_slab = bool(ensure is not None and ensure(T + 1))
+            if use_slab:
+                # carry the previous segment's bootstrap obs into row 0
+                self.vec_env.rebase_row0()
         if self._obs_sharding is not None:
             # the epoch's incoming key was split outside the mesh; place
             # it next to the params explicitly (after step 0 the key is
@@ -938,10 +1133,18 @@ class RolloutCollector:
             _, rewards, dones = self.vec_env.step(actions)
             rew_buf[t] = rewards
             done_buf[t] = dones
-        if use_slab:
-            # one bulk memcpy of the worker-written slab rows into a
-            # fresh buffer (see docstring: staging must never alias the
-            # reused slab); np.array allocates + copies in one call
+        if segment is not None:
+            # ring path: the trajectory IS the leased segment's rows —
+            # zero-copy views, safe without the bulk defensive copy
+            # because the segment is not rewritten until its release
+            # token (attached by the caller once the staged batch is
+            # provably consumed) reports ready
+            traj_obs = dict(self.vec_env.traj_obs_views(T))
+        elif use_slab:
+            # single-slab path: one bulk memcpy of the worker-written
+            # slab rows into a fresh buffer (see docstring: staging
+            # must never alias the reused slab); np.array allocates +
+            # copies in one call
             views = self.vec_env.traj_obs_views(T)
             traj_obs = {k: np.array(v) for k, v in views.items()}
             if telemetry.enabled():
@@ -952,10 +1155,13 @@ class RolloutCollector:
                         if self._obs_sharding is not None else final)
         rng, _, _, last_values = step_fn(params, final_staged, rng)
         # ONE drain for every deferred future (all long since ready —
-        # this is a batch of buffer copies, not a wait)
+        # this is a batch of buffer copies, not a wait). It also blocks
+        # on the bootstrap forward, so the staged `final` (possibly an
+        # alias of the segment's bootstrap row) is consumed before the
+        # segment is handed over.
         logp_host, val_host, last_host = jax.device_get(
             (logp_refs, val_refs, last_values))
-        return {
+        out = {
             "traj": {"obs": traj_obs, "actions": act_buf,
                      "logp": np.stack(logp_host).astype(np.float32),
                      "values": np.stack(val_host).astype(np.float32),
@@ -964,6 +1170,17 @@ class RolloutCollector:
             "episodes": self.vec_env.drain_completed_episodes(),
             "env_steps": T * B,
         }
+        if segment is not None:
+            # ownership passes to the learner; the caller MUST run the
+            # two-phase token protocol (ring.note_staged/note_update —
+            # train/loops.py and bench.py are the models), quoting the
+            # generation so a late token can't release a recycled
+            # segment
+            ring.publish(segment)
+            out["ring"] = ring
+            out["ring_segment"] = segment
+            out["ring_generation"] = segment.generation
+        return out
 
     def collect(self, params, rng) -> Dict[str, Any]:
         """Run rollout_length steps in every env; returns a trajectory dict

@@ -25,7 +25,13 @@ Ownership contract (CLAUDE.md invariant):
   parent's live segment when the worker exits) and only ever write their
   own ``[row, env_index]`` slice, between receiving a step command and
   sending the reply — the reply on the pipe is the per-worker ready
-  flag; the parent reads a slice only after that flag.
+  flag; the parent reads a slice only after that flag;
+* above the slab, the trajectory RING (rl/ring.py) adds a per-segment
+  ledger — the collector owns a segment from lease to publish, the
+  learner from publish to release, and release (token-driven) is the
+  only point a segment becomes writable again. Workers are oblivious:
+  a ring just means K slab attachments and a ``(segment, row)`` write
+  destination instead of a bare row.
 
 ``scripts/check_shm_unlink.py`` (tier-1) enforces that every
 ``SharedMemory(create=True)`` in the package keeps the paired
@@ -213,3 +219,27 @@ class SlabAttachment:
             except BufferError:
                 pass
         self._segments = []
+
+
+class RingAttachment:
+    """Worker-side mapping of a trajectory ring's K segments (attach by
+    name, never create, never unlink — one ``SlabAttachment`` per ring
+    segment). ``views_for(seg)`` selects the segment a ``(seg, row)``
+    step destination addresses."""
+
+    def __init__(self, segment_specs: Sequence[Sequence[SlabField]]):
+        self.segments: List[SlabAttachment] = []
+        try:
+            for spec in segment_specs:
+                self.segments.append(SlabAttachment(spec))
+        except Exception:
+            self.close()
+            raise
+
+    def views_for(self, seg: int) -> Dict[str, np.ndarray]:
+        return self.segments[seg].views
+
+    def close(self) -> None:
+        for att in self.segments:
+            att.close()
+        self.segments = []

@@ -202,12 +202,20 @@ class RLEpochLoop:
       under ``train.host_sync``. The two modes are bit-identical in
       params/metrics/episodes (pinned in tests/test_train_pipeline.py);
       only the dispatch/sync schedule differs.
-    * ``pipeline_depth=1`` (opt-in, off-policy-tolerant learners only —
-      IMPALA, whose V-trace correction exists precisely for this lag)
-      additionally collects epoch n+1 on a background thread against the
-      params from before epoch n's update, so host env stepping overlaps
-      the device update. Learners whose update assumes fresher data
-      (ppo/pg/dqn/es) reject ``pipeline_depth > 0`` loudly.
+    * ``pipeline_depth >= 1`` (opt-in, off-policy-tolerant learners only
+      — IMPALA, whose V-trace correction exists precisely for this lag)
+      additionally keeps up to ``pipeline_depth`` collected batches in
+      flight on a background thread against pre-update params, so host
+      env stepping overlaps the device update. Each batch's params
+      snapshot is taken at submission; the behavior logp travelling in
+      the traj lets V-trace absorb however many updates land before the
+      batch is consumed (the per-batch ``params_age_updates`` metric
+      reports exactly that lag). On the shm backend the batches ride a
+      ``pipeline_depth + 2``-segment trajectory ring (rl/ring.py) whose
+      lease→publish→release ownership replaces the per-segment bulk
+      copy. Learners whose update assumes fresh data (ppo/pg/dqn/es)
+      reject ``pipeline_depth > 0`` loudly, as does any
+      ``loop_mode != "pipelined"``.
 
     Fused mode (rl/fused.py, docs/perf_round8.md):
 
@@ -311,9 +319,9 @@ class RLEpochLoop:
         self._chip_lock = None
         self.metrics_sync_interval = max(int(metrics_sync_interval or 1), 1)
         self.pipeline_depth = int(pipeline_depth or 0)
-        if self.pipeline_depth < 0 or self.pipeline_depth > 1:
+        if self.pipeline_depth < 0:
             raise ValueError(
-                f"pipeline_depth must be 0 or 1, got {pipeline_depth}")
+                f"pipeline_depth must be >= 0, got {pipeline_depth}")
         if self.pipeline_depth and not self.SUPPORTS_STALE_COLLECTION:
             raise ValueError(
                 f"{type(self).__name__} does not support pipeline_depth > "
@@ -332,13 +340,16 @@ class RLEpochLoop:
         # bit-exact either way (tests/test_shm.py pins pipe==shm params/
         # episodes), so the default favours the cheaper transport
         self.vec_env_backend = vec_env_backend
-        # pipelining runtime state: the prefetched (out, straj, slv)
-        # future, the unsynced-metrics ring, and the lazily-created
+        # pipelining runtime state: the queue of prefetched
+        # (out, straj, slv) futures (depth entries deep, each tagged
+        # with the update-counter version its params snapshot was taken
+        # at), the unsynced-metrics ring, and the lazily-created
         # executors (collection thread / device-update watcher)
-        self._collect_future = None
+        self._collect_futures: List[Any] = []
         self._collect_executor = None
         self._watch_executor = None
         self._metrics_ring: List[Any] = []
+        self._updates_dispatched = 0
 
         self._configure_algo(algo_config, num_envs, rollout_length)
         # collection backend: host vectorised envs (default) or the
@@ -477,7 +488,12 @@ class RLEpochLoop:
             return
         self.collector = RolloutCollector(
             self.vec_env, self.learner, self.rollout_length,
-            deferred_fetch=(self.loop_mode == "pipelined"))
+            deferred_fetch=(self.loop_mode == "pipelined"),
+            # ring capacity: depth prefetched batches + the one being
+            # consumed + one of slack, so a healthy steady state never
+            # stalls a lease (rl/ring.py counts the stalls if it does)
+            ring_segments=(self.pipeline_depth + 2
+                           if self.loop_mode == "pipelined" else None))
         self.collector._needs_reset = False  # env already reset in __init__
 
     def _fused_step_fn(self):
@@ -706,50 +722,76 @@ class RLEpochLoop:
     # ------------------------------------------------- pipelining plumbing
     def _collect_and_stage(self, params, rng):
         """Collect one batch and stage it on the mesh (double-buffered
-        under ``pipeline_depth=1``: staging the next batch runs on the
-        collection thread while the update consumes the previous one,
-        whose donated buffers free as it runs)."""
+        under ``pipeline_depth >= 1``: staging the next batches runs on
+        the collection thread while the update consumes the previous
+        one, whose donated buffers free as it runs).
+
+        Ring handoff (rl/ring.py): when the collector leased a
+        trajectory-ring segment, the alias verdict is probed here on
+        the segment's FIRST staging (does ``shard_traj``'s device_put
+        share the segment's host memory? — the np.shares_memory
+        question, answered pointer-wise so it runs under the transfer
+        guard). Alias-free segments get the staged tree itself as
+        their release token (free the moment the copies land); aliased
+        segments wait for an update-output token attached in ``run``."""
         with telemetry.span("train.collect"):
             out = self.collector.collect(params, rng)
         with telemetry.span("train.device_transfer"):
             straj, slv = self.learner.shard_traj(out["traj"],
                                                  out["last_values"])
+        segment = out.get("ring_segment")
+        if segment is not None:
+            # phase 1 of the ring token protocol (rl/ring.py
+            # note_staged): alias verdict + copy-case token
+            out["ring"].note_staged(segment, straj["obs"],
+                                    generation=out.get("ring_generation"))
         return out, straj, slv
 
     def _next_batch(self):
-        """The epoch's staged batch; under ``pipeline_depth=1`` also
-        kicks off the NEXT epoch's collection on the background thread
-        against the CURRENT (pre-update) params — once the caller
-        dispatches this epoch's update, that collection is exactly one
-        update stale, which V-trace corrects. The rng stream is split on
-        the main thread in submission order, so collection n consumes
-        the same key in every mode (bit-exactness across depths of what
-        each batch is collected WITH is not promised — staleness is the
-        point — but the rng bookkeeping stays deterministic and
-        process-local, preserving the multi-host rules)."""
+        """The epoch's staged batch; under ``pipeline_depth >= 1`` also
+        tops the background-collection queue back up to ``depth``
+        batches, each submitted against the CURRENT (pre-update) params
+        — once the caller dispatches updates, a queued batch is as many
+        updates stale as landed before its consumption (its
+        ``params_age``), which V-trace corrects. The rng stream is
+        split on the main thread in submission order, so collection n
+        consumes the same key in every mode (bit-exactness across
+        depths of what each batch is collected WITH is not promised —
+        staleness is the point — but the rng bookkeeping stays
+        deterministic and process-local, preserving the multi-host
+        rules). The queue-top-up gate is a pure function of the queue
+        length and the configured depth — deterministic, multi-host
+        safe."""
         import jax
         import jax.numpy as jnp
 
-        if self._collect_future is not None:
-            future, self._collect_future = self._collect_future, None
-            out = future.result()
+        if self._collect_futures:
+            future, version = self._collect_futures.pop(0)
+            out, straj, slv = future.result()
+            out["params_age"] = self._updates_dispatched - version
         else:
-            out = self._collect_and_stage(self.state.params,
-                                          self._split_collect_rng())
+            out, straj, slv = self._collect_and_stage(
+                self.state.params, self._split_collect_rng())
+            out["params_age"] = 0
         if self.pipeline_depth:
             if self._collect_executor is None:
                 from concurrent.futures import ThreadPoolExecutor
 
                 self._collect_executor = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="collect-pipeline")
-            # jnp.copy: the live state is about to be DONATED into the
-            # update, which deletes its param buffers out from under a
-            # concurrent reader; the stale collector needs its own copy
-            params = jax.tree_util.tree_map(jnp.copy, self.state.params)
-            rng = self._split_collect_rng()
-            self._collect_future = self._collect_executor.submit(
-                self._collect_and_stage, params, rng)
-        return out
+            while len(self._collect_futures) < self.pipeline_depth:
+                # jnp.copy: the live state is about to be DONATED into
+                # the update, which deletes its param buffers out from
+                # under a concurrent reader; the stale collector needs
+                # its own copy
+                params = jax.tree_util.tree_map(jnp.copy,
+                                                self.state.params)
+                rng = self._split_collect_rng()
+                self._collect_futures.append((
+                    self._collect_executor.submit(
+                        self._collect_and_stage, params, rng),
+                    self._updates_dispatched))
+        return out, straj, slv
 
     def _watch_update(self, metrics, t0: float) -> None:
         """Record the in-flight update's device wall as a
@@ -777,20 +819,26 @@ class RLEpochLoop:
 
         self._watch_executor.submit(_block)
 
-    def _harvest_metrics(self, metrics) -> Any:
+    def _harvest_metrics(self, metrics, extras: Optional[dict] = None
+                         ) -> Any:
         """Sequential mode: the pre-pipelining per-update blocking fetch
         (one ``train.host_sync`` span per epoch). Pipelined mode: wrap
         the device dict as a LazyMetrics future on the unsynced ring;
-        ``_maybe_sync_metrics`` drains the ring at sync boundaries."""
+        ``_maybe_sync_metrics`` drains the ring at sync boundaries.
+        ``extras`` are host-side scalars (e.g. the depth-K loop's
+        ``params_age_updates``) that ride the mapping without touching
+        the device."""
         import jax
 
         if self.loop_mode == "sequential":
             with telemetry.span("train.host_sync"):
-                return {k: float(v)
-                        for k, v in jax.device_get(metrics).items()}
+                fetched = {k: float(v)
+                           for k, v in jax.device_get(metrics).items()}
+            fetched.update(extras or {})
+            return fetched
         from ddls_tpu.train.metrics import LazyMetrics
 
-        lazy = LazyMetrics(metrics)
+        lazy = LazyMetrics(metrics, extras=extras)
         self._metrics_ring.append(lazy)
         return lazy
 
@@ -814,6 +862,14 @@ class RLEpochLoop:
         """Force-drain any unsynced metrics (checkpoint/shutdown/test
         boundary)."""
         self._maybe_sync_metrics(force=True)
+
+    def ring_stats(self) -> Optional[Dict[str, Any]]:
+        """The trajectory ring's ledger counters (rl/ring.py stats:
+        segments/leases/stalls/occupancy/mean params-age), or None when
+        no ring is installed. Host ints only — safe to fetch at a
+        reporting boundary (the bench JSON line's ``ring`` block)."""
+        ring = getattr(self.vec_env, "traj_ring", None)
+        return ring.stats() if ring is not None else None
 
     # ------------------------------------------------------- fused epoch
     def _maybe_drain_fused_episodes(self, force: bool = False
@@ -895,12 +951,29 @@ class RLEpochLoop:
             self.state, metrics = self.learner.train_step(
                 self.state, straj, slv, self._split_rng())
         del straj, slv  # donated on accelerator backends: moved-from
+        self._updates_dispatched += 1
+        segment = out.get("ring_segment")
+        if segment is not None:
+            # phase 2 of the ring token protocol: alias-case segments
+            # may only be rewritten once the update that read their
+            # bytes is done — an update output is exactly that marker
+            out["ring"].note_update(segment, metrics["total_loss"],
+                                    generation=out.get("ring_generation"))
         if self.loop_mode == "pipelined":
             self._watch_update(metrics, update_t0)
 
         self.epoch_counter += 1
         self.total_env_steps += out["env_steps"]
-        learner_metrics = self._harvest_metrics(metrics)
+        extras = None
+        if self.pipeline_depth:
+            # per-batch staleness in updates (the lag V-trace absorbs);
+            # host ints — never a device fetch
+            age = int(out.get("params_age", 0))
+            extras = {"params_age_updates": age}
+            ring = out.get("ring")
+            if ring is not None:
+                ring.observe_params_age(age)
+        learner_metrics = self._harvest_metrics(metrics, extras=extras)
         self._maybe_sync_metrics()
         results: Dict[str, Any] = {
             "epoch_counter": self.epoch_counter,
@@ -921,14 +994,14 @@ class RLEpochLoop:
                 and self.epoch_counter % self.evaluation_interval == 0):
             # eval is a logging boundary: drain any unsynced metric
             # futures first (the deterministic eval gate itself already
-            # syncs the host with the device). A pipeline_depth=1
-            # background collection must also settle first — its env
+            # syncs the host with the device). Any pipeline_depth >= 1
+            # background collections must also settle first — their env
             # stepping draws from the process-global numpy/random state
             # that evaluate() snapshots and reseeds, and racing those
             # would corrupt both streams.
             self._maybe_sync_metrics(force=True)
-            if self._collect_future is not None:
-                self._collect_future.result()
+            for future, _ in self._collect_futures:
+                future.result()
             with telemetry.span("train.eval"):
                 results["evaluation"] = self.evaluate(
                     self.evaluation_duration)
@@ -1142,12 +1215,12 @@ class RLEpochLoop:
         self.wandb.log(flat)
 
     def close(self) -> None:
-        if self._collect_future is not None:
+        for future, _ in self._collect_futures:
             try:  # leave the env workers in a consistent state
-                self._collect_future.result(timeout=60)
+                future.result(timeout=60)
             except Exception:
                 pass
-            self._collect_future = None
+        self._collect_futures = []
         for executor in (self._collect_executor, self._watch_executor):
             if executor is not None:
                 executor.shutdown(wait=True)
@@ -1403,11 +1476,16 @@ class ImpalaEpochLoop(RLEpochLoop):
     V-trace update per batch (reference: algo/impala.yaml through
     rllib_epoch_loop.py:34).
 
-    The one loop where ``pipeline_depth=1`` is sound: collection n+1 runs
-    on a background thread against params(n-1) while the device applies
-    update n — V-trace's importance weighting corrects exactly that
-    policy lag (one epoch deeper than the lag it already tolerates), in
-    the actor/learner-decoupled shape Podracer/MSRL/SEED-RL pipeline."""
+    The one loop where ``pipeline_depth >= 1`` is sound: up to ``depth``
+    collections run ahead on the background thread against pre-update
+    params while the device applies updates — V-trace's importance
+    weighting corrects exactly that policy lag (reported per batch as
+    ``params_age_updates``), in the actor/learner-decoupled shape of
+    the Podracer/MSRL/SEED-RL pipelines. On the shm backend the
+    in-flight batches live in a ``depth + 2``-segment trajectory ring
+    (rl/ring.py) whose ownership ledger stands in for the per-segment
+    bulk copy; other backends fall back to fresh per-collect buffers,
+    correct either way."""
 
     SUPPORTS_STALE_COLLECTION = True
 

@@ -190,6 +190,52 @@ def _flight_section(flight_events: List[dict]) -> List[str]:
     return lines + [""]
 
 
+def _ring_section(sections: Dict[str, Dict[str, Any]]) -> List[str]:
+    """Trajectory-ring ledger rollup (rl/ring.py, ISSUE 15): lease/
+    stall/publish/release counters, the lease-time occupancy histogram
+    (how full the ring ran — a saturated ring means the learner gated
+    collection), and the mean params age in updates (the staleness
+    V-trace absorbed). All from the last snapshot's gated
+    ``rollout.ring.*`` metrics."""
+    counters = sections.get("counters") or {}
+    hists = sections.get("histograms") or {}
+    ring_counters = {k: v for k, v in counters.items()
+                     if k.startswith("rollout.ring.")}
+    occ = hists.get("rollout.ring.occupancy")
+    age = hists.get("rollout.ring.params_age_updates")
+    if not ring_counters and not occ and not age:
+        return []
+    lines = ["== trajectory ring (rollout.ring.*) =="]
+    for name in ("lease", "stall", "publish", "release"):
+        key = f"rollout.ring.{name}"
+        if key in ring_counters:
+            lines.append(f"{name + 's':<28}{ring_counters[key]:>10}")
+    if occ and occ.get("count"):
+        lines.append("")
+        lines.append(f"{'occupancy at lease':<28}{'count':>10}")
+        buckets = occ.get("buckets") or {}
+        for bound, n in sorted(
+                ((b, c) for b, c in buckets.items() if b != "+inf"),
+                key=lambda kv: float(kv[0])):
+            if int(n):
+                lines.append(f"{'<= ' + f'{float(bound):g}':<28}"
+                             f"{int(n):>10}")
+        overflow = int(buckets.get("+inf", 0))
+        if overflow:
+            lines.append(f"{'> max bucket':<28}{overflow:>10}")
+        if occ.get("mean") is not None:
+            lines.append(f"{'mean_occupancy':<28}{occ['mean']:>10.3f}")
+    if age and age.get("count"):
+        lines.append("")
+        lines.append(f"{'params_age_updates count':<28}"
+                     f"{age['count']:>10}")
+        if age.get("mean") is not None:
+            lines.append(f"{'mean_params_age':<28}{age['mean']:>10.3f}")
+        if age.get("max") is not None:
+            lines.append(f"{'max_params_age':<28}{age['max']:>10.3f}")
+    return lines + [""]
+
+
 def _fleet_section(serve: Dict[str, Any]) -> List[str]:
     """Per-replica comparison when the snapshot's ``serve`` subtree
     carries a fleet dump (``r<id>`` replica registries + the
@@ -290,6 +336,7 @@ def render_report(path: str) -> List[str]:
         lines += _fleet_section(last_snapshot["serve"])
     if last_snapshot:
         sections = _walk_snapshot(last_snapshot)
+        lines += _ring_section(sections)
         if sections.get("counters"):
             lines += ["== counters (last snapshot) =="]
             for name, value in sorted(sections["counters"].items()):

@@ -245,6 +245,29 @@ def test_shm_unlink_overgranted_allowance_is_stale(tmp_path):
     assert res.errors == []
 
 
+def test_shm_unlink_multi_segment_triple(tmp_path):
+    """ISSUE 15 fixture: a trajectory-ring-shaped file creating THREE
+    segments must flag every create line when the pairing is missing,
+    and go clean once the unlink + finalizer pair appears (one pairing
+    covers all segments of a ring, as SlabSet does per segment)."""
+    triple = ("from multiprocessing import shared_memory\n"
+              "ring = [shared_memory.SharedMemory(create=True, size=64),\n"
+              "        shared_memory.SharedMemory(create=True, size=64),\n"
+              "        shared_memory.SharedMemory(create=True, size=64)]\n")
+    res = lint_tree(tmp_path, {"ring.py": triple}, "shm-unlink")
+    flagged = errors_of(res, "shm-unlink")
+    assert [f.line for f in flagged] == [2, 3, 4]
+    assert all("3 create(s)" in f.message for f in flagged)
+
+    paired = (triple
+              + "import weakref\n"
+              + "for seg in ring:\n"
+              + "    weakref.finalize(seg, seg.unlink)\n"
+              + "    seg.unlink()\n")
+    res = lint_tree(tmp_path, {"ring.py": paired}, "shm-unlink")
+    assert res.errors == []
+
+
 def test_shm_unlink_suppressed(tmp_path):
     src = ("from multiprocessing import shared_memory\n"
            "seg = shared_memory.SharedMemory(create=True, size=64)  "

@@ -9,6 +9,11 @@
   CPU mesh (the full-collect acceptance pin);
 * slab-trajectory contract — the deferred-fetch collector's traj rows
   ARE the slab (row t = obs before step t);
+* trajectory-ring contract (rl/ring.py, ISSUE 15) — K independently-
+  owned segments behind the same pipe-ack protocol: lease → publish →
+  token-driven release, zero-copy traj views protected by ownership
+  (never rewritten before release), stalls counted when the learner
+  gates collection, zero /dev/shm litter on every exit path;
 * lifecycle hardening — a killed worker raises a clear error instead of
   hanging, ``close()`` is idempotent, and no ``/dev/shm`` segment
   outlives the env (kill path included);
@@ -271,50 +276,232 @@ def test_shm_traj_slab_rows_are_the_trajectory():
         vec.close()
 
 
-@pytest.mark.shm
-def test_deferred_collect_traj_never_aliases_the_slab():
-    """Regression pin for the zero-copy-aliasing hazard: jax's CPU
-    client zero-copy aliases page-aligned host buffers (shm mmaps are)
-    when no layout change is needed — e.g. on a 1-device mesh — so the
-    trajectory handed to the async update MUST be a fresh buffer, never
-    slab views, or the next segment's worker writes would rewrite the
-    update's training data in flight."""
+def _toy_collector(vec, rollout_length=4, n_devices=1, **collector_kw):
+    """A tiny PPO learner + deferred-fetch collector over ``vec`` (the
+    shared scaffolding of the slab/ring aliasing pins)."""
     import jax
 
     from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
     from ddls_tpu.parallel import make_mesh
     from ddls_tpu.rl import PPOConfig, PPOLearner, RolloutCollector
+
+    model = GNNPolicy(n_actions=N_ACTIONS)
+    obs0 = jax.tree_util.tree_map(np.asarray, vec.obs[0])
+    params = model.init(jax.random.PRNGKey(0), obs0)
+    learner = PPOLearner(
+        lambda p, o: batched_policy_apply(model, p, o),
+        PPOConfig(num_sgd_iter=1, sgd_minibatch_size=2,
+                  train_batch_size=8), make_mesh(n_devices))
+    collector = RolloutCollector(vec, learner, rollout_length,
+                                 deferred_fetch=True, **collector_kw)
+    collector._needs_reset = False
+    return learner, learner.init_state(params), collector
+
+
+@pytest.mark.shm
+def test_deferred_collect_traj_never_aliases_the_slab():
+    """Regression pin for the zero-copy-aliasing hazard on the LEGACY
+    single-slab path (``ring_segments=0``): jax's CPU client zero-copy
+    aliases page-aligned host buffers (shm mmaps are) when no layout
+    change is needed — e.g. on a 1-device mesh — so the trajectory
+    handed to the async update MUST be a fresh buffer, never slab
+    views, or the next segment's worker writes would rewrite the
+    update's training data in flight. (The trajectory ring retires the
+    copy by ownership instead — see the ring pins below.)"""
+    import jax
+
     from ddls_tpu.rl.rollout import OBS_KEYS, ParallelVectorEnv
 
     vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="shm")
     try:
         vec.reset()
-        model = GNNPolicy(n_actions=N_ACTIONS)
-        obs0 = jax.tree_util.tree_map(np.asarray, vec.obs[0])
-        params = model.init(jax.random.PRNGKey(0), obs0)
-        learner = PPOLearner(
-            lambda p, o: batched_policy_apply(model, p, o),
-            PPOConfig(num_sgd_iter=1, sgd_minibatch_size=2,
-                      train_batch_size=8), make_mesh(1))
-        collector = RolloutCollector(vec, learner, rollout_length=4,
-                                     deferred_fetch=True)
-        collector._needs_reset = False
-        out = collector.collect(learner.init_state(params).params,
-                                jax.random.PRNGKey(1))
+        learner, state, collector = _toy_collector(vec, ring_segments=0)
+        out = collector.collect(state.params, jax.random.PRNGKey(1))
         assert vec._slabs is not None and vec._slabs.rows == 5
+        assert vec.traj_ring is None
         snapshot = {k: np.copy(out["traj"]["obs"][k]) for k in OBS_KEYS}
         for k in OBS_KEYS:
             assert not np.shares_memory(out["traj"]["obs"][k],
                                         vec._slabs.views[k]), k
         # a second segment rewrites every slab row; the first segment's
         # trajectory must not move
-        collector.collect(learner.init_state(params).params,
-                          jax.random.PRNGKey(2))
+        collector.collect(state.params, jax.random.PRNGKey(2))
         for k in OBS_KEYS:
             np.testing.assert_array_equal(out["traj"]["obs"][k],
                                           snapshot[k], err_msg=k)
     finally:
         vec.close()
+
+
+# ------------------------------------------------------- trajectory ring
+def test_traj_ring_ledger_stall_and_timeout():
+    """Ring ledger unit pins (no workers involved): round-robin lease
+    order, publish-before-release enforcement, stall counting + bounded
+    timeout when every segment is unreleased, and token-driven release
+    (an object without the ``is_ready`` protocol counts as ready)."""
+    from ddls_tpu.rl.ring import TrajRing
+
+    fields = {"x": ((3,), np.dtype(np.float32))}
+    ring = TrajRing(fields, rows=2, num_envs=2, segments=2)
+    try:
+        a = ring.lease()
+        with pytest.raises(RuntimeError, match="leased"):
+            ring.publish(ring.segments[1])  # never leased
+        ring.publish(a)
+        b = ring.lease()
+        ring.publish(b)
+        # every segment published, no release token anywhere: the next
+        # lease must stall and surface a clear timeout, never hang
+        with pytest.raises(RuntimeError, match="ring lease timed out"):
+            ring.lease(timeout_s=0.2)
+        assert ring.stalls == 1
+        ring.set_release_token(a, object())  # no is_ready -> ready
+        c = ring.lease(timeout_s=5.0)
+        assert c is a and c.state == "leased"
+        assert ring.releases == 1
+        stats = ring.stats()
+        assert stats["segments"] == 2 and stats["leases"] == 3
+        assert stats["stalls"] == 1
+        assert sum(stats["occupancy_counts"]) == stats["leases"] + 1
+        # generation fencing: a SLOW consumer's late token (quoting an
+        # older lease) must not release the segment's new batch
+        ring.publish(c)
+        ring.set_release_token(c, object(), generation=c.generation - 1)
+        assert c.release_token is None  # stale token ignored
+        ring.set_release_token(c, object(), generation=c.generation)
+        assert c.release_token is not None
+    finally:
+        ring.close()
+
+
+@pytest.mark.shm
+def test_ring_traj_views_owned_until_release():
+    """The ISSUE 15 aliasing pin, per segment: the deferred collector's
+    ring trajectory IS the leased segment (``np.shares_memory`` TRUE —
+    the PR 4 bulk defensive copy is gone), and a segment staged into
+    the async update is never rewritten before its release token
+    reports ready — collection rotates to other segments and only
+    reuses this one after release."""
+    import jax
+
+    from ddls_tpu.rl.rollout import OBS_KEYS, ParallelVectorEnv
+
+    vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="shm")
+    try:
+        vec.reset()
+        learner, state, collector = _toy_collector(vec, ring_segments=2)
+        out = collector.collect(state.params, jax.random.PRNGKey(1))
+        ring, seg = out["ring"], out["ring_segment"]
+        assert ring is vec.traj_ring and seg.state == "published"
+        # zero-copy contract: the trajectory is the segment's rows
+        for k in OBS_KEYS:
+            assert np.shares_memory(out["traj"]["obs"][k],
+                                    seg.views[k]), k
+        # stage into the update exactly as the loop does, and take the
+        # lease-time alias verdict: on a 1-device CPU mesh device_put
+        # zero-copy aliases the shm segment
+        straj, slv = learner.shard_traj(out["traj"], out["last_values"])
+        from ddls_tpu.rl.ring import staged_aliases
+
+        seg.aliased = staged_aliases(straj["obs"], seg.views)
+        assert seg.aliased is True
+        snapshot = {k: np.copy(v) for k, v in out["traj"]["obs"].items()}
+        # the next collect must take the OTHER segment and leave this
+        # one's bytes untouched (it is published, not released)
+        out2 = collector.collect(state.params, jax.random.PRNGKey(2))
+        assert out2["ring_segment"] is not seg
+        for k in OBS_KEYS:
+            np.testing.assert_array_equal(out["traj"]["obs"][k],
+                                          snapshot[k], err_msg=k)
+        # consume the staged batch, attach the update token -> the
+        # segment becomes reusable and a third collect leases it again
+        state2, metrics = learner.train_step(state, straj, slv,
+                                             jax.random.PRNGKey(3))
+        ring.set_release_token(seg, metrics["total_loss"])
+        ring.set_release_token(out2["ring_segment"], object())
+        # make the update token provably ready so the next sweep's
+        # round-robin deterministically hands segment 0 back
+        jax.block_until_ready(metrics["total_loss"])
+        out3 = collector.collect(state.params, jax.random.PRNGKey(4))
+        assert out3["ring_segment"] is seg
+        assert ring.stats()["leases"] == 3
+    finally:
+        vec.close()
+
+
+@pytest.mark.shm
+def test_ring_multi_device_staging_does_not_alias():
+    """The other half of the lease-time verdict: a multi-device mesh's
+    strided batch shards force real copies, so the staged tree shares
+    no memory with the segment and the segment may release as soon as
+    staging lands (token = the staged tree itself)."""
+    import jax
+
+    from ddls_tpu.rl.rollout import ParallelVectorEnv
+
+    vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="shm")
+    try:
+        vec.reset()
+        learner, state, collector = _toy_collector(vec, n_devices=2,
+                                                   ring_segments=2)
+        out = collector.collect(state.params, jax.random.PRNGKey(1))
+        seg = out["ring_segment"]
+        straj, _ = learner.shard_traj(out["traj"], out["last_values"])
+        from ddls_tpu.rl.ring import staged_aliases
+
+        assert staged_aliases(straj["obs"], seg.views) is False
+        # staged-tree token: ready once the copies complete — make
+        # that deterministic, then pin that the next lease's sweep
+        # actually RELEASES the segment on this token (the copy-path
+        # release, no update output involved)
+        out["ring"].set_release_token(seg, straj)
+        jax.block_until_ready(straj)
+        collector.collect(state.params, jax.random.PRNGKey(2))
+        assert out["ring"].stats()["releases"] == 1
+        assert seg.state == "free"
+    finally:
+        vec.close()
+
+
+@pytest.mark.shm
+def test_ring_kill_and_crash_paths_leave_no_litter():
+    """ISSUE 15 hardening pin for K segments: a killed worker still
+    surfaces as a clear error, ``close()`` unlinks EVERY ring segment
+    (kill path included), and a garbage-collected ring unlinks through
+    the per-segment finalizers even when close() never ran."""
+    import gc
+
+    import jax
+
+    from ddls_tpu.rl.ring import TrajRing
+    from ddls_tpu.rl.rollout import ParallelVectorEnv
+
+    vec = ParallelVectorEnv(ZeroPadToyEnv, {}, 2, backend="shm")
+    vec.reset()
+    _, state, collector = _toy_collector(vec, ring_segments=3)
+    out = collector.collect(state.params, jax.random.PRNGKey(1))
+    names = list(vec.traj_ring.segment_names())
+    assert len(names) == 3 * len(vec._slabs.views)  # 3 segments worth
+    # a direct step on the PUBLISHED segment is a loud ledger violation
+    with pytest.raises(RuntimeError, match="PUBLISHED"):
+        vec.step(np.zeros(2, np.int32))
+    out["ring"].release(out["ring_segment"])  # hand it back, then step
+    vec._procs[1].kill()
+    vec._procs[1].join(timeout=10)
+    with pytest.raises(RuntimeError, match="died"):
+        for _ in range(3):
+            vec.step(np.zeros(2, np.int32))
+    vec.close()  # idempotent after the error path's close
+    assert not _leaked(names)
+
+    # crash path: no close() at all — the SlabSet finalizers fire on gc
+    ring = TrajRing({"x": ((3,), np.dtype(np.float32))}, rows=2,
+                    num_envs=2, segments=3)
+    names = ring.segment_names()
+    assert _leaked(names) == names
+    del ring
+    gc.collect()
+    assert not _leaked(names)
 
 
 @pytest.mark.shm
@@ -413,17 +600,21 @@ def _env_config(dataset_dir):
 
 
 @pytest.mark.shm
-@pytest.mark.parametrize("algo,algo_config", [
+@pytest.mark.parametrize("algo,algo_config,depth", [
     ("ppo", {"train_batch_size": 8, "sgd_minibatch_size": 4,
-             "num_sgd_iter": 2, "num_workers": 2}),
-    ("impala", {"lr": 1e-3, "train_batch_size": 8, "num_workers": 2}),
-], ids=["ppo", "impala"])
-def test_full_collect_parity_pipe_vs_shm(algo, algo_config, dataset_dir):
-    """The ISSUE 5 acceptance pin: identical post-training params,
-    episode records, and learner metrics for the same seeds under the
-    pipe and shm transports — the zero-copy restructure must not move a
-    single bit of the training math (pipelined loop = the deferred-fetch
-    collector riding the slab trajectory on the shm side)."""
+             "num_sgd_iter": 2, "num_workers": 2}, 0),
+    ("impala", {"lr": 1e-3, "train_batch_size": 8, "num_workers": 2}, 0),
+    ("impala", {"lr": 1e-3, "train_batch_size": 8, "num_workers": 2}, 1),
+], ids=["ppo", "impala", "impala-depth1"])
+def test_full_collect_parity_pipe_vs_shm(algo, algo_config, depth,
+                                         dataset_dir):
+    """The ISSUE 5 acceptance pin, extended by ISSUE 15 to the
+    trajectory ring: identical post-training params, episode records,
+    and learner metrics for the same seeds under the pipe and shm
+    transports — at depth 0 AND at depth 1, where the shm side rides
+    the multi-segment ring (ownership-protected zero-copy views) while
+    pipe uses fresh per-collect buffers. The ring must be a pure
+    transport swap below the training math."""
     import jax
 
     from ddls_tpu.train import make_epoch_loop
@@ -438,16 +629,24 @@ def test_full_collect_parity_pipe_vs_shm(algo, algo_config, dataset_dir):
             algo_config=dict(algo_config),
             num_envs=2, rollout_length=4, n_devices=2,
             use_parallel_envs=True, vec_env_backend=backend,
-            evaluation_interval=None, seed=0, loop_mode="pipelined")
+            evaluation_interval=None, seed=0, loop_mode="pipelined",
+            pipeline_depth=depth)
         assert loop.vec_env.backend == backend
         records = []
-        for _ in range(2):
+        for _ in range(2 if depth == 0 else 3):
             r = loop.run()
             records.append({"learner": dict(r["learner"]),
                             "episodes": r["episodes"],
                             "env_steps": r["env_steps_this_iter"]})
         loop.sync_metrics()
         params = jax.device_get(loop.state.params)
+        if backend == "shm":
+            # the shm side actually exercised the ring (depth + 2
+            # segments) — the parity below is about the ring, not a
+            # silent fallback
+            assert loop.vec_env.traj_ring is not None
+            assert (len(loop.vec_env.traj_ring.segments)
+                    == loop.pipeline_depth + 2)
         loop.close()
         outcomes[backend] = (records, params)
 
@@ -487,9 +686,48 @@ def test_shm_epoch_stays_transfer_free(dataset_dir):
     try:
         assert loop.vec_env.backend == "shm"
         loop.run()  # warm epoch: compiles + first-use constant transfers
+        loop.run()  # second ring segment's first staging (alias probe)
         with jax.transfer_guard("disallow"):
             r = loop.run()
         assert np.isfinite(r["learner"]["total_loss"])
+    finally:
+        loop.close()
+
+
+@pytest.mark.shm
+def test_ring_depth2_epoch_stays_transfer_free(dataset_dir):
+    """ISSUE 15 transfer-guard pin: the steady-state depth-2 epoch adds
+    no implicit device↔host transfer on the main thread — ring lease
+    sweeps are pointer/readiness checks, release tokens attach without
+    fetching, params-age metrics are host ints. Warmup covers every
+    segment's one-time alias probe (depth + 2 segments)."""
+    import jax
+
+    from ddls_tpu.train import make_epoch_loop
+
+    loop = make_epoch_loop(
+        "impala",
+        path_to_env_cls=ENV_CLS,
+        env_config=_env_config(dataset_dir),
+        model=_TINY_MODEL,
+        algo_config={"lr": 1e-3, "train_batch_size": 8,
+                     "num_workers": 2},
+        num_envs=2, rollout_length=4, n_devices=2,
+        use_parallel_envs=True, vec_env_backend="shm",
+        evaluation_interval=None, seed=0, loop_mode="pipelined",
+        pipeline_depth=2, metrics_sync_interval=1000)
+    try:
+        assert loop.vec_env.backend == "shm"
+        for _ in range(4):  # every segment staged at least once
+            loop.run()
+        with jax.transfer_guard("disallow"):
+            r = loop.run()
+        assert np.isfinite(r["learner"]["total_loss"])
+        assert r["learner"]["params_age_updates"] == 2.0
+        stats = loop.ring_stats()
+        assert stats is not None and stats["segments"] == 4
+        assert stats["leases"] >= 5
+        assert stats["mean_params_age"] is not None
     finally:
         loop.close()
 

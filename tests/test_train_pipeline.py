@@ -193,12 +193,21 @@ def test_pipeline_depth_rejected_loudly(algo, dataset_dir):
 
 
 def test_pipeline_depth_validation(dataset_dir):
+    # depth >= 2 is the IMPALA-only ring surface (ISSUE 15): negative
+    # depths and non-pipelined modes stay loudly rejected; ppo keeps
+    # rejecting ANY depth > 0 (covered by the parametrised test above)
     with pytest.raises(ValueError, match="pipeline_depth"):
         make_epoch_loop("impala", path_to_env_cls=ENV_CLS, env_config={},
+                        pipeline_depth=-1)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        make_epoch_loop("ppo", path_to_env_cls=ENV_CLS, env_config={},
                         pipeline_depth=2)
     with pytest.raises(ValueError, match="loop_mode"):
         make_epoch_loop("impala", path_to_env_cls=ENV_CLS, env_config={},
                         loop_mode="sequential", pipeline_depth=1)
+    with pytest.raises(ValueError, match="loop_mode"):
+        make_epoch_loop("impala", path_to_env_cls=ENV_CLS, env_config={},
+                        loop_mode="sequential", pipeline_depth=2)
     with pytest.raises(ValueError, match="loop_mode"):
         make_epoch_loop("ppo", path_to_env_cls=ENV_CLS, env_config={},
                         loop_mode="bogus")
@@ -215,18 +224,45 @@ def test_impala_stale_pipeline_trains(dataset_dir):
                       pipeline_depth=1)
     before = jax.device_get(loop.state.params)
     r1 = loop.run()
-    assert loop._collect_future is not None  # next batch already cooking
+    assert len(loop._collect_futures) == 1  # next batch already cooking
     r2 = loop.run()
     r3 = loop.run()
     for r in (r1, r2, r3):
         assert r["env_steps_this_iter"] == 8
         assert np.isfinite(r["learner"]["total_loss"])
+    # steady-state staleness at depth 1 is exactly one update
+    assert r1["learner"]["params_age_updates"] == 0.0  # inline first batch
+    assert r2["learner"]["params_age_updates"] == 1.0
+    assert r3["learner"]["params_age_updates"] == 1.0
     moved = jax.tree_util.tree_map(
         lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
         before, jax.device_get(loop.state.params))
     assert max(jax.tree_util.tree_leaves(moved)) > 0
     loop.close()
-    assert loop._collect_future is None  # drained on close
+    assert not loop._collect_futures  # drained on close
+
+
+def test_impala_depth_k_pipeline_trains(dataset_dir):
+    """pipeline_depth=2 (the ISSUE 15 depth-K surface): up to two
+    collected batches ride ahead of the learner, each consumed with
+    the params age V-trace absorbs; the in-process vec env exercises
+    the ring-less fallback (fresh per-collect buffers), so depth-K is
+    transport-independent."""
+    loop = _make_loop("impala", dataset_dir, "pipelined",
+                      {"lr": 1e-3, "train_batch_size": 8,
+                       "num_workers": 2},
+                      pipeline_depth=2)
+    results = [loop.run() for _ in range(4)]
+    assert len(loop._collect_futures) == 2  # queue topped to depth
+    for r in results:
+        assert r["env_steps_this_iter"] == 8
+        assert np.isfinite(r["learner"]["total_loss"])
+        assert np.isfinite(r["learner"]["clip_rho_fraction"])
+    ages = [r["learner"]["params_age_updates"] for r in results]
+    assert ages[0] == 0.0  # first batch collected inline, fresh params
+    assert ages[-1] == 2.0  # steady state: two updates behind
+    loop.close()
+    assert not loop._collect_futures
 
 
 # -------------------------------------------- ParallelVectorEnv prefetch
@@ -368,3 +404,43 @@ def test_report_script_overlap_section(tmp_path):
     report = "\n".join(telemetry_report.render_report(str(path)))
     assert "== overlap" in report
     assert "overlap_fraction" in report
+
+
+def test_report_script_ring_section(tmp_path):
+    """The trajectory-ring report section (ISSUE 15): lease/stall
+    counters, the lease-time occupancy histogram, and mean params-age
+    rendered from a snapshot's gated rollout.ring.* metrics."""
+    import json
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts"))
+    import telemetry_report
+
+    from ddls_tpu import telemetry
+    from ddls_tpu.rl.ring import OCCUPANCY_BUCKETS
+
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for _ in range(3):
+            telemetry.inc("rollout.ring.lease")
+        telemetry.inc("rollout.ring.stall")
+        for occ in (0, 1, 1):
+            telemetry.observe("rollout.ring.occupancy", occ,
+                              buckets=OCCUPANCY_BUCKETS)
+        for age in (1, 2):
+            telemetry.observe("rollout.ring.params_age_updates", age,
+                              buckets=OCCUPANCY_BUCKETS)
+        snapshot = telemetry.snapshot()
+    finally:
+        telemetry.reset()
+        telemetry.disable()
+    path = tmp_path / "ring.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "snapshot", "data": snapshot}) + "\n")
+    report = "\n".join(telemetry_report.render_report(str(path)))
+    assert "== trajectory ring" in report
+    assert "stalls" in report and "occupancy at lease" in report
+    assert "mean_params_age" in report
