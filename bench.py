@@ -410,20 +410,53 @@ def _make_vec_env(dataset_dir: str, num_envs: int, backend: str = "pipe",
                       for _ in range(num_envs)], seeds=seeds)
 
 
+# the bench workload's graph-set knobs: shared by _make_dataset and the
+# sim record's scenario fingerprint so the two can never drift
+_SIM_DATASET_KNOBS = {"n_cnn": 3, "n_translation": 2, "seed": 0,
+                      "min_ops": 8, "max_ops": 16}
+
+
 def _make_dataset() -> str:
     from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
 
     dataset_dir = tempfile.mkdtemp(prefix="bench_small_graphs_")
-    generate_pipedream_txt_files(dataset_dir, n_cnn=3, n_translation=2,
-                                 seed=0, min_ops=8, max_ops=16)
+    generate_pipedream_txt_files(dataset_dir, **_SIM_DATASET_KNOBS)
     return dataset_dir
+
+
+def _sim_scenario_block(kwargs: dict) -> dict:
+    """The sim workload expressed as a fingerprinted ScenarioSpec
+    (ddls_tpu/scenarios), so BENCH_* artifacts name the workload they
+    measured: the fingerprint re-keys on ANY workload knob change
+    (--ab-degree included) while the default bench setup itself stays
+    the canonical reference-scale one (this block only reports)."""
+    from ddls_tpu.scenarios import ScenarioSpec, spec_fingerprint
+
+    jc = kwargs["jobs_config"]
+    spec = ScenarioSpec(
+        name="bench_canonical",
+        topology=kwargs["topology_config"],
+        node_config=kwargs["node_config"],
+        jobs=dict(_SIM_DATASET_KNOBS),
+        arrival={"kind": "fixed",
+                 "interarrival": jc["job_interarrival_time_dist"]["val"]},
+        sla={"kind": "uniform", "min": 0.1, "max": 1.0, "decimals": 2},
+        replication_factor=jc["replication_factor"],
+        num_training_steps=jc["num_training_steps"],
+        job_sampling_mode=jc["job_sampling_mode"],
+        max_partitions_per_op=kwargs["max_partitions_per_op"],
+        min_op_run_time_quantum=kwargs["min_op_run_time_quantum"],
+        sim_seconds=kwargs["max_simulation_run_time"],
+        pad_obs=dict(kwargs["pad_obs_kwargs"]))
+    return {"name": spec.name, "fingerprint": spec_fingerprint(spec)}
 
 
 def run_sim_bench(args) -> dict:
     """Pure simulator throughput: vectorised env stepping with random valid
     actions, no learner in the loop. Isolates the host hot path
     (reference hot loop: ramp_job_partitioning_environment.py:300)."""
-    vec = _make_vec_env(_make_dataset(), args.num_envs,
+    dataset_dir = _make_dataset()
+    vec = _make_vec_env(dataset_dir, args.num_envs,
                         max_degree=args.ab_degree)
     vec.reset()
     rng = np.random.RandomState(0)
@@ -458,6 +491,9 @@ def run_sim_bench(args) -> dict:
         "baseline_source": BASELINE_SOURCE,
         "num_envs": args.num_envs,
         "cores": _available_cores(),
+        # which workload this number is about (fingerprinted spec)
+        "scenario": _sim_scenario_block(
+            make_env_kwargs(dataset_dir, max_degree=args.ab_degree)),
         # warmup/run wall split + the simulator's own cache counters
         # (lookahead/partition memo hit rates) from the same snapshot
         "telemetry": telemetry.snapshot(),

@@ -70,6 +70,7 @@ class RampJobPartitioningEnvironment:
                  apply_action_mask: bool = True,
                  candidate_pricing: Optional[str] = None,
                  obs_include_candidate_prices: bool = False,
+                 scenario_runtime=None,
                  **kwargs):
         self.topology_config = topology_config
         self.node_config = node_config
@@ -103,7 +104,8 @@ class RampJobPartitioningEnvironment:
             use_sqlite_database=use_sqlite_database,
             use_jax_lookahead=use_jax_lookahead,
             use_native_lookahead=use_native_lookahead,
-            suppress_warnings=suppress_warnings)
+            suppress_warnings=suppress_warnings,
+            scenario_runtime=scenario_runtime)
 
         self.max_partitions_per_op = (
             max_partitions_per_op if max_partitions_per_op is not None
@@ -282,15 +284,12 @@ class RampJobPartitioningEnvironment:
                 if (cause == "simulation_ended"
                         and ji in self.action.job_idxs):
                     # placed, then swept at simulation end: accepted at
-                    # decision time; its jct is on the lookahead event
-                    # emitted earlier this step (the partitioned job
-                    # itself was already unmounted)
+                    # decision time; its jct comes from the cluster's
+                    # adjusted-jct ledger (the SCENARIO-adjusted value —
+                    # the lookahead event carries the nominal one; the
+                    # partitioned job itself was already unmounted)
                     accepted, cause = True, None
-                    for ev in reversed(_flight.recorder().events):
-                        if (ev["kind"] == "lookahead"
-                                and ev["job_idx"] == ji):
-                            jct = float(ev["jct"])
-                            break
+                    jct = float(cluster.job_adjusted_jct[ji])
             _flight.emit("action_decided", t=t_dec, job_idx=ji,
                          degree=action, mask=mask, accepted=accepted,
                          cause=cause, jct=jct)

@@ -29,6 +29,14 @@ run):
    (``MEMO_TRACE_KEYS``) must be traced by ``make_segment_fn``, so the
    counters drain with the episode counters rather than silently
    vanishing from the compact trace.
+5. The scenario failure-event vocabulary (``scenarios/failures.py``,
+   ISSUE 16): the ``FAILURE_*`` kind codes pairwise distinct,
+   ``FAILURE_KIND_TO_EVENT`` a bijection over them, and every event
+   string present in BOTH backend vocabularies — the flight recorder's
+   ``EVENT_KINDS`` tuple (``telemetry/flight.py``) and a string literal
+   at the host emission site (``sim/cluster.py``) — so a failure-kind
+   rename cannot leave one backend emitting events the other side
+   filters out.
 """
 from __future__ import annotations
 
@@ -43,6 +51,8 @@ DEFAULT_PATHS = {
     "ppo_device": "ddls_tpu/rl/ppo_device.py",
     "rollout": "ddls_tpu/rl/rollout.py",
     "jax_memo": "ddls_tpu/sim/jax_memo.py",
+    "failures": "ddls_tpu/scenarios/failures.py",
+    "flight": "ddls_tpu/telemetry/flight.py",
     "host_cause_files": ["ddls_tpu/sim/cluster.py",
                          "ddls_tpu/sim/actions.py"],
 }
@@ -94,9 +104,11 @@ class BackendSurfaceParityRule(Rule):
                "(CLAUDE.md: any semantic change lands in all backends): "
                "keep CAUSE_CODE_TO_STR bijective over the CAUSE_* "
                "constants, host cause strings in sim/cluster.py//"
-               "sim/actions.py, and make_segment_fn's ep_* trace keys in "
+               "sim/actions.py, make_segment_fn's ep_* trace keys in "
                "sync with rl/ppo_device.py + rollout.py's "
-               "harvest_episode_record keys")
+               "harvest_episode_record keys, and scenarios/failures.py's "
+               "FAILURE_KIND_TO_EVENT events in flight EVENT_KINDS + "
+               "cluster.py literals")
     scope_dirs = ()  # tree-level rule: no per-file pass
 
     def in_scope(self, rel: str) -> bool:
@@ -119,12 +131,16 @@ class BackendSurfaceParityRule(Rule):
         ppo_device = _get_sf(ctx, str(paths["ppo_device"]))
         rollout = _get_sf(ctx, str(paths["rollout"]))
         jax_memo = _get_sf(ctx, str(paths["jax_memo"]))
+        failures = _get_sf(ctx, str(paths["failures"]))
+        flight = _get_sf(ctx, str(paths["flight"]))
         host_files = [_get_sf(ctx, str(p))
                       for p in paths["host_cause_files"]]
         for rel, sf in ([(paths["jax_env"], jax_env),
                          (paths["ppo_device"], ppo_device),
                          (paths["rollout"], rollout),
-                         (paths["jax_memo"], jax_memo)]
+                         (paths["jax_memo"], jax_memo),
+                         (paths["failures"], failures),
+                         (paths["flight"], flight)]
                         + list(zip(paths["host_cause_files"],
                                    host_files))):
             if sf is None or sf.tree is None:
@@ -150,6 +166,12 @@ class BackendSurfaceParityRule(Rule):
                 and host_files[0].tree is not None):
             findings.extend(self._check_memo_surface(
                 jax_memo, host_files[0], jax_env))
+        if all(sf is not None and sf.tree is not None
+               for sf in (failures, flight)) \
+                and host_files and host_files[0] is not None \
+                and host_files[0].tree is not None:
+            findings.extend(self._check_failure_surface(
+                failures, flight, host_files[0]))
         return findings
 
     # --------------------------------------------------------- cause codes
@@ -288,6 +310,99 @@ class BackendSurfaceParityRule(Rule):
                     "make_segment_fn (nor emitted by "
                     "memo_trace_counters) — memo counters would not "
                     "drain with the episode counters"))
+        return findings
+
+    # ------------------------------------------------- failure vocabulary
+    def _check_failure_surface(self, failures: SourceFile,
+                               flight: SourceFile,
+                               cluster: SourceFile) -> List[Finding]:
+        """The scenario failure-event contract (scenarios/failures.py):
+        FAILURE_* kind codes pairwise distinct, FAILURE_KIND_TO_EVENT a
+        bijection over them, and every event string present in BOTH
+        backend vocabularies — the flight recorder's EVENT_KINDS tuple
+        and a string literal at the host emission site (sim/cluster.py),
+        where the lint contract requires LITERAL kinds."""
+        findings: List[Finding] = []
+        constants: Dict[str, int] = {}
+        table: Dict[str, object] = {}
+        table_line = 1
+        for node in failures.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            if (target.id.startswith("FAILURE_")
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, int)):
+                constants[target.id] = node.value.value
+            elif (target.id == "FAILURE_KIND_TO_EVENT"
+                  and isinstance(node.value, ast.Dict)):
+                table_line = node.lineno
+                for k, v in zip(node.value.keys, node.value.values):
+                    kname = (k.id if isinstance(k, ast.Name) else
+                             ast.unparse(k))
+                    table[kname] = (v.value if isinstance(v, ast.Constant)
+                                    else ast.unparse(v))
+        if not constants or not table:
+            return [Finding(
+                self.id, failures.rel, 1,
+                "could not locate the FAILURE_* constants / "
+                "FAILURE_KIND_TO_EVENT table — the failure-event surface "
+                "moved; update backend-surface-parity")]
+
+        values = sorted(constants.values())
+        if len(set(values)) != len(values):
+            findings.append(Finding(
+                self.id, failures.rel, table_line,
+                f"FAILURE_* kind codes are not pairwise distinct: "
+                f"{constants}"))
+        missing = sorted(set(constants) - set(table))
+        extra = sorted(set(table) - set(constants))
+        if missing or extra:
+            findings.append(Finding(
+                self.id, failures.rel, table_line,
+                f"FAILURE_KIND_TO_EVENT is not a bijection over the "
+                f"FAILURE_* kind codes (missing {missing}, "
+                f"unknown {extra})"))
+        events = [v for v in table.values() if isinstance(v, str)]
+        dupes = sorted({e for e in events if events.count(e) > 1})
+        if dupes:
+            findings.append(Finding(
+                self.id, failures.rel, table_line,
+                f"FAILURE_KIND_TO_EVENT event strings are not unique "
+                f"(duplicated: {dupes}) — the event->kind inverse is "
+                "ambiguous"))
+
+        kinds: Set[str] = set()
+        for node in flight.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "EVENT_KINDS"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                kinds = {e.value for e in node.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str)}
+        if not kinds:
+            findings.append(Finding(
+                self.id, flight.rel, 1,
+                "could not locate the EVENT_KINDS tuple — the flight "
+                "event vocabulary moved; update backend-surface-parity"))
+        host_strings = _str_constants(cluster.tree)
+        for event in sorted(set(events)):
+            if kinds and event not in kinds:
+                findings.append(Finding(
+                    self.id, failures.rel, table_line,
+                    f"failure event {event!r} is not in the flight "
+                    f"recorder's EVENT_KINDS ({flight.rel}) — the "
+                    "recorder would drop it at load/validate time"))
+            if event not in host_strings:
+                findings.append(Finding(
+                    self.id, failures.rel, table_line,
+                    f"failure event {event!r} is never a string literal "
+                    f"in {cluster.rel} — no host emission site (the "
+                    "flight-gated contract requires literal kinds at "
+                    "the emit call)"))
         return findings
 
     # ----------------------------------------------------- episode fields

@@ -1,0 +1,27 @@
+"""Scenario subsystem: one declarative spec, four conforming backends.
+
+``spec.ScenarioSpec`` names a fabric + workload + device speeds + a
+deterministic failure schedule; ``conformance.run_conformance`` proves
+host / C++ / jax lookahead / jitted-episode agreement on it (CLI:
+``python scripts/conformance.py --json``). See docs/scenarios.md.
+"""
+from ddls_tpu.scenarios.failures import (FAILURE_CHANNEL_STRAGGLE,
+                                         FAILURE_KIND_TO_EVENT,
+                                         FAILURE_WORKER_PREEMPT,
+                                         ScenarioRuntime, inflate_duration,
+                                         inflate_duration_jax)
+from ddls_tpu.scenarios.spec import (REGISTRY, ScenarioError, ScenarioSpec,
+                                     build_runtime, canonical_spec,
+                                     env_kwargs, failures_spec, get_spec,
+                                     multi_channel_spec,
+                                     resolve_failure_windows,
+                                     spec_fingerprint, validate_spec)
+
+__all__ = [
+    "FAILURE_CHANNEL_STRAGGLE", "FAILURE_KIND_TO_EVENT",
+    "FAILURE_WORKER_PREEMPT", "ScenarioRuntime", "inflate_duration",
+    "inflate_duration_jax", "REGISTRY", "ScenarioError", "ScenarioSpec",
+    "build_runtime", "canonical_spec", "env_kwargs", "failures_spec",
+    "get_spec", "multi_channel_spec", "resolve_failure_windows",
+    "spec_fingerprint", "validate_spec",
+]

@@ -66,8 +66,16 @@ class RampClusterEnvironment:
                  suppress_warnings: bool = True,
                  use_jax_lookahead: bool = False,
                  use_native_lookahead: str | bool = "auto",
-                 machine_epsilon: float = 1e-7):
+                 machine_epsilon: float = 1e-7,
+                 scenario_runtime=None):
         self.name = name
+        # scenario subsystem (ddls_tpu/scenarios, docs/scenarios.md):
+        # deterministic failure windows + device-speed multipliers,
+        # applied as completion-time inflation at lookahead REGISTRATION
+        # — every lookahead backend stays nominal, so host/C++/jax
+        # lookahead parity is untouched; None (the default) keeps the
+        # legacy hot path byte-identical
+        self.scenario_runtime = scenario_runtime
         self.use_sqlite_database = use_sqlite_database
         # opt-in array-engine lookahead backend (docs/jax_lookahead_gonogo.md)
         self.use_jax_lookahead = use_jax_lookahead
@@ -163,6 +171,12 @@ class RampClusterEnvironment:
         self.step_counter = 0
         self.action = None
         self.op_partition = None
+        # scenario bookkeeping: next failure window whose t0-crossing
+        # flight event is still unemitted, and the per-job ADJUSTED jct
+        # ledger (== nominal when no scenario) that survives unmount —
+        # the env's end-of-sim sweep reads it (envs/partitioning_env.py)
+        self._scenario_emit_ptr = 0
+        self.job_adjusted_jct: Dict[int, float] = {}
 
         # memo caches: partition_cache is keyed by (model, full split map)
         # and lookahead_cache by (model, split map, canonical worker
@@ -623,6 +637,16 @@ class RampClusterEnvironment:
         step_time = jct / max(job.num_training_steps, 1)
         util = busy / (n_mounted * step_time) if step_time > 0 else 0.0
 
+        # scenario inflation (ddls_tpu/scenarios): the SLA gate above and
+        # util stay NOMINAL (admission is failure-blind by design); only
+        # the realized completion time is adjusted. The jitted decision
+        # kernel applies the same shared formula (sim/jax_env.py).
+        job.details["nominal_lookahead_jct"] = jct
+        sr = self.scenario_runtime
+        if sr is not None and not sr.is_nominal:
+            jct = self._scenario_adjusted_jct(job, jct)
+        self.job_adjusted_jct[job.details["job_idx"]] = jct
+
         job.details["lookahead_job_completion_time"] = jct
         job.details["communication_overhead_time"] = comm_oh
         job.details["computation_overhead_time"] = comp_oh
@@ -639,6 +663,30 @@ class RampClusterEnvironment:
                 if run_time != 0:
                     flow_size += job.graph.edge_size(*edge)
         job.details["job_total_flow_size"] = flow_size
+
+    def _scenario_adjusted_jct(self, job: Job, nominal: float) -> float:
+        """Adjusted completion time under the attached ScenarioRuntime:
+        progress gated at the slowest mounted server's speed, failure
+        windows (on mounted servers/channels) multiplied on top — the
+        shared formula in scenarios/failures.py, which the jitted
+        kernel mirrors with identical f64 op order."""
+        from ddls_tpu.scenarios.failures import (FAILURE_WORKER_PREEMPT,
+                                                 inflate_duration)
+
+        sr = self.scenario_runtime
+        server_index = self.topology.dense_tables()["server_index"]
+        w2s = self.topology.worker_to_server
+        srv = {server_index[w2s[w]]
+               for w in job.details["mounted_workers"]}
+        r0 = min((float(sr.speeds[i]) for i in srv), default=1.0)
+        chans = job.details["mounted_channels"]
+        affects = [
+            (w["resource"] in srv)
+            if w["kind"] == FAILURE_WORKER_PREEMPT
+            else (w["resource"] in chans)
+            for w in sr.windows]
+        return inflate_duration(job.details["time_started"], nominal, r0,
+                                sr.win_t0, sr.win_t1, sr.win_rate, affects)
 
     # ------------------------------------------------------------------- step
     def step(self, action, verbose: bool = False):
@@ -684,6 +732,30 @@ class RampClusterEnvironment:
                              n_running=len(self.jobs_running))
             self._accumulate_tick_stats(tick)
             self.stopwatch.tick(tick)
+
+            # scenario failure windows: emit each window's crossing event
+            # once when the clock first passes its t0. The pointer always
+            # advances (recorder on or off), and the emitted ``t`` is the
+            # window's own t0 — a pure function of (seed, spec) — so
+            # traces stay bit-identical across lookahead backends.
+            sr = self.scenario_runtime
+            if sr is not None and self._scenario_emit_ptr < len(sr.windows):
+                now = self.stopwatch.time()
+                while (self._scenario_emit_ptr < len(sr.windows)
+                       and sr.windows[self._scenario_emit_ptr]["t0"] <= now):
+                    w = sr.windows[self._scenario_emit_ptr]
+                    self._scenario_emit_ptr += 1
+                    if _flight.enabled():
+                        from ddls_tpu.scenarios.failures import \
+                            FAILURE_WORKER_PREEMPT
+                        if w["kind"] == FAILURE_WORKER_PREEMPT:
+                            _flight.emit("worker_preempted", t=w["t0"],
+                                         server=w["resource"], t0=w["t0"],
+                                         t1=w["t1"], rate=w["rate"])
+                        else:
+                            _flight.emit("channel_degraded", t=w["t0"],
+                                         channel=w["resource"], t0=w["t0"],
+                                         t1=w["t1"], rate=w["rate"])
 
             completed = []
             for job in self.jobs_running.values():

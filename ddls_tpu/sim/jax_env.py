@@ -816,6 +816,11 @@ class EpisodeTables:
     success_reward: float
     fail_reward: float
     worker_mem: float          # per-server memory capacity at reset
+    # scenario mirror (ddls_tpu/scenarios): dense speeds + failure
+    # windows captured from env.cluster.scenario_runtime; None when the
+    # scenario is nominal, so the kernels build NO inflation code and
+    # the default episode program stays byte-identical
+    scenario: Optional[dict] = None
 
 
 def build_episode_tables(env, max_degree: Optional[int] = None,
@@ -866,6 +871,19 @@ def build_episode_tables(env, max_degree: Optional[int] = None,
     workers = list(topo.workers.values())
     if len({w.memory_capacity for w in workers}) != 1:
         raise ValueError("jitted episode needs homogeneous worker memory")
+    # scenario mirror: completion-time inflation inputs in dense index
+    # space (window kind/resource stay HOST ints -> static unroll)
+    sr = getattr(env.cluster, "scenario_runtime", None)
+    scenario = None
+    if sr is not None and not sr.is_nominal:
+        scenario = {
+            "speeds": np.asarray(sr.speeds, np.float64),
+            "t0": np.asarray(sr.win_t0, np.float64),
+            "t1": np.asarray(sr.win_t1, np.float64),
+            "rate": np.asarray(sr.win_rate, np.float64),
+            "kind": [int(k) for k in sr.win_kind],
+            "res": [int(r) for r in sr.win_res],
+        }
     return EpisodeTables(
         st=st, tables=jt, pads=pads, types=types, degrees=degrees,
         comm={"x": topo.num_communication_groups,
@@ -880,7 +898,8 @@ def build_episode_tables(env, max_degree: Optional[int] = None,
         eps=env.cluster.machine_epsilon,
         success_reward=getattr(env.reward_function, "success_reward", 1.0),
         fail_reward=getattr(env.reward_function, "fail_reward", -1.0),
-        worker_mem=float(workers[0].memory_capacity))
+        worker_mem=float(workers[0].memory_capacity),
+        scenario=scenario)
 
 
 def build_job_bank(et: EpisodeTables, records: Sequence[dict]) -> dict:
@@ -974,6 +993,31 @@ def _episode_kernels(et: EpisodeTables):
     deg_col = jnp.asarray(deg_col)
     eps = et.eps
     sim_end = et.sim_end
+
+    # scenario inflation mirror (ddls_tpu/scenarios/failures.py): same
+    # shared f64 formula the host applies at lookahead registration —
+    # SLA stays judged on the NOMINAL jct (eval_cfg), only the committed
+    # completion time and the traced jct are adjusted. None -> no code.
+    scenario = et.scenario
+    if scenario is not None:
+        from ddls_tpu.scenarios.failures import (FAILURE_WORKER_PREEMPT,
+                                                 inflate_duration_jax)
+
+        _sdt = et.tables["dep_size"].dtype
+        sc_speeds = jnp.asarray(scenario["speeds"], _sdt)
+        sc_t0 = jnp.asarray(scenario["t0"], _sdt)
+        sc_t1 = jnp.asarray(scenario["t1"], _sdt)
+        sc_rate = jnp.asarray(scenario["rate"], _sdt)
+        sc_kind, sc_res = scenario["kind"], scenario["res"]
+
+        def scenario_adjusted(t, jct, srv_mask, chan_mask):
+            r0 = jnp.min(jnp.where(srv_mask, sc_speeds,
+                                   jnp.asarray(jnp.inf, _sdt)))
+            affects = [srv_mask[r] if k == FAILURE_WORKER_PREEMPT
+                       else chan_mask[r]
+                       for k, r in zip(sc_kind, sc_res)]
+            return inflate_duration_jax(t, jct, r0, sc_t0, sc_t1,
+                                        sc_rate, affects)
 
     def eval_cfg(bank, carry, row, cfg, memo=None):
         """Evaluate ONE (job, degree) candidate against the live cluster
@@ -1084,6 +1128,11 @@ def _episode_kernels(et: EpisodeTables):
         action_ok = (action > 0) & (deg_col[jnp.clip(action, 0)] >= 0)
         ((accept, cause, jct, new_mem, srv_mask, chan_mask),
          memo) = jax.lax.cond(action_ok, heavy, zero, memo)
+
+        if scenario is not None:
+            # inflate AFTER the accept/cause decision: admission is
+            # failure-blind (host: _register_completed_lookahead)
+            jct = scenario_adjusted(t, jct, srv_mask, chan_mask)
 
         slot = jnp.argmin(slot_valid).astype(jnp.int32)  # first free slot
         accept = accept & ~jnp.all(slot_valid)  # cannot trigger (R=n_srv)

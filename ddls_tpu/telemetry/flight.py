@@ -68,6 +68,10 @@ EVENT_KINDS = (
     "job_blocked",      # cluster._register_blocked_job (with cause)
     "op_completed",     # detail: host lookahead engine, per-op finish
     "flow_completed",   # detail: host lookahead engine, per-flow finish
+    "worker_preempted", # cluster.step: scenario preemption window's t0
+                        # crossed (t == window t0: pure (seed, spec) fn)
+    "channel_degraded", # cluster.step: scenario straggler window's t0
+                        # crossed (same determinism contract)
 )
 
 # kinds only the HOST lookahead engine can produce (the C++/jax engines

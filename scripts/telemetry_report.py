@@ -160,6 +160,21 @@ def _flight_section(flight_events: List[dict]) -> List[str]:
         lines += ["", f"{'blocked by cause':<44}{'count':>8}"]
         for cause, n in sorted(summ["blocked_by_cause"].items()):
             lines.append(f"{cause:<44}{n:>8}")
+    # scenario failure windows (ddls_tpu/scenarios): per-resource tally
+    # of the deterministic preemption/straggler crossings in the trace
+    fails: Dict[str, int] = {}
+    for e in flight_events:
+        if e.get("kind") == "worker_preempted":
+            key = f"worker_preempted (server {e.get('server', '?')})"
+        elif e.get("kind") == "channel_degraded":
+            key = f"channel_degraded (channel {e.get('channel', '?')})"
+        else:
+            continue
+        fails[key] = fails.get(key, 0) + 1
+    if fails:
+        lines += ["", f"{'scenario failure window':<44}{'count':>8}"]
+        for key, n in sorted(fails.items()):
+            lines.append(f"{key:<44}{n:>8}")
     jobs = summ["jobs"]
     if jobs:
         lines += ["", f"{'job':>9} {'arrived':>12} {'deg':>4} "

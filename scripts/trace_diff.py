@@ -16,15 +16,24 @@ Usage::
     # host decisions vs the fully-jitted episode replay (x64, 1e-9 rtol)
     python scripts/trace_diff.py run --backend-b jitted
 
+    # any registry/spec-file scenario instead of the canonical setup
+    python scripts/trace_diff.py run --scenario failures
+    python scripts/trace_diff.py run --scenario my_spec.json
+
     # diff two previously saved traces (e.g. from --save-a/--save-b)
     python scripts/trace_diff.py files a.jsonl b.jsonl
 
 Backends: ``host`` (pure-Python lookahead), ``native`` (C++ engine),
-``jax`` (jitted lookahead kernel — f32 by default, so expect rounding
-divergence unless JAX_ENABLE_X64=1), ``jitted`` (the whole-episode
+``jax`` (jitted lookahead kernel — its array packers are f32 by
+construction, so pass ``--rtol 1e-4``, the tolerance
+tests/test_jax_lookahead.py pins), ``jitted`` (the whole-episode
 kernel ``sim/jax_env.py:make_episode_fn`` replaying the host action
 sequence; compared at decision level — `action_decided` events only,
 mask context dropped since the replay kernel sees no observation).
+
+The episode/diff machinery lives in ``ddls_tpu/scenarios/conformance.py``
+(this script is a thin wrapper over the conformance harness; the full
+multi-leg run is ``scripts/conformance.py``).
 
 The comparison excludes detail kinds (per-op/flow completions exist only
 on the host engine) and context fields (``backend``, ``seq``, ``env``)
@@ -38,7 +47,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -47,140 +55,6 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 HOST_BACKENDS = ("host", "native", "jax")
-
-
-def make_env(dataset_dir: str, backend: str, max_sim_run_time: float):
-    """The canonical single-channel RAMP scenario (8 servers — the same
-    shape the golden tests pin) with the requested lookahead backend."""
-    from ddls_tpu.envs import RampJobPartitioningEnvironment
-
-    return RampJobPartitioningEnvironment(
-        topology_config={"type": "ramp", "kwargs": {
-            "num_communication_groups": 2,
-            "num_racks_per_communication_group": 2,
-            "num_servers_per_rack": 2,
-            "num_channels": 1,
-            "total_node_bandwidth": 1.6e12,
-            "intra_gpu_propagation_latency": 50e-9,
-            "worker_io_latency": 100e-9}},
-        node_config={"type_1": {"num_nodes": 8, "workers_config": [
-            {"num_workers": 1, "worker": "A100"}]}},
-        jobs_config={
-            "path_to_files": dataset_dir,
-            "job_interarrival_time_dist": {
-                "_target_": "ddls_tpu.demands.distributions.Fixed",
-                "val": 1000.0},
-            "max_acceptable_job_completion_time_frac_dist": {
-                "_target_": "ddls_tpu.demands.distributions.Uniform",
-                "min_val": 0.1, "max_val": 1.0, "decimals": 2},
-            "replication_factor": 10,
-            "job_sampling_mode": "remove_and_repeat",
-            "num_training_steps": 50},
-        max_partitions_per_op=8,
-        min_op_run_time_quantum=0.01,
-        reward_function="job_acceptance",
-        reward_function_kwargs={"fail_reward": -1, "success_reward": 1},
-        max_simulation_run_time=max_sim_run_time,
-        pad_obs_kwargs={"max_nodes": 64, "max_edges": 256},
-        use_jax_lookahead=(backend == "jax"),
-        use_native_lookahead=(backend == "native"))
-
-
-def run_recorded_episode(env, seed: int, actions=None,
-                         max_decisions: int = 500, detail: bool = False):
-    """One seeded episode under a fresh flight recorder; returns
-    (events, actions_taken). With ``actions`` given, replays that
-    sequence (truncating when the episode ends early or a replayed
-    action goes mask-invalid — both only happen past a divergence, which
-    the diff will already have found)."""
-    import numpy as np
-
-    from ddls_tpu.telemetry import flight
-
-    prev = (flight.recorder().enabled, flight.recorder().detail)
-    flight.reset()
-    flight.enable(detail=detail)
-    try:
-        obs = env.reset(seed=seed)
-        rng = np.random.RandomState(seed)
-        taken = []
-        done = False
-        while not done and len(taken) < max_decisions:
-            if actions is not None:
-                if len(taken) >= len(actions):
-                    break
-                action = int(actions[len(taken)])
-            else:
-                valid = np.flatnonzero(np.asarray(obs["action_mask"]))
-                action = int(rng.choice(valid))
-            try:
-                obs, _, done, _ = env.step(action)
-            except ValueError:
-                break  # replayed action invalid here: post-divergence
-            taken.append(action)
-        events = flight.drain()
-    finally:
-        flight.reset()
-        flight.recorder().enabled, flight.recorder().detail = prev
-    return events, taken
-
-
-def decision_events(events):
-    """The decision-level view of a host trace: `action_decided` events
-    with the observation-mask context dropped (the jitted replay kernel
-    sees no observation, so the mask is host-only context here) and the
-    blocked cause CANONICALISED through the trace-code maps — several
-    host sub-action causes collapse onto one code (e.g. 'op_partition'
-    -> op_placement), and the jitted side can only ever name the
-    canonical string."""
-    from ddls_tpu.sim.jax_env import CAUSE_CODE_TO_STR, CAUSE_STR_TO_CODE
-    from ddls_tpu.telemetry import flight
-
-    out = []
-    for e in flight.comparable_events(events, kinds=("action_decided",)):
-        e = {k: v for k, v in e.items() if k != "mask"}
-        code = CAUSE_STR_TO_CODE.get(e.get("cause"))
-        if code is not None:
-            e["cause"] = CAUSE_CODE_TO_STR[code]
-        out.append(e)
-    return out
-
-
-def jitted_decision_events(env, host_events, actions):
-    """Replay the host action sequence through the fully-jitted episode
-    kernel and express its per-decision trace as `action_decided`
-    events (the job bank is rebuilt from the host trace's own
-    job_arrived events)."""
-    import jax.numpy as jnp
-    import numpy as np
-
-    from ddls_tpu.sim.jax_env import (CAUSE_CODE_TO_STR,
-                                      build_episode_tables,
-                                      build_job_bank, make_episode_fn)
-
-    arrivals = [{"model": e["model"],
-                 "num_training_steps": e["num_training_steps"],
-                 "sla_frac": e["sla_frac"],
-                 "time_arrived": e["t"]}
-                for e in host_events if e["kind"] == "job_arrived"]
-    et = build_episode_tables(env)
-    bank = build_job_bank(et, arrivals)
-    out = make_episode_fn(et)(
-        {k: jnp.asarray(v) for k, v in bank.items()},
-        jnp.asarray(actions, jnp.int32))
-    reward, accept, cause, jct, t, has_job = (np.asarray(x)
-                                              for x in out["trace"])
-    events = []
-    for i, action in enumerate(actions):
-        if not has_job[i]:
-            break  # kernel ran out of queued jobs (post-divergence)
-        accepted = bool(accept[i])
-        events.append({
-            "kind": "action_decided", "t": float(t[i]), "job_idx": i,
-            "degree": int(action), "accepted": accepted,
-            "cause": CAUSE_CODE_TO_STR[int(cause[i])],
-            "jct": float(jct[i]) if accepted else 0.0})
-    return events
 
 
 def _report(div, label_a: str, label_b: str, n_a: int, n_b: int) -> int:
@@ -192,6 +66,10 @@ def _report(div, label_a: str, label_b: str, n_a: int, n_b: int) -> int:
 
 
 def cmd_run(args) -> int:
+    from ddls_tpu.scenarios import get_spec
+    from ddls_tpu.scenarios.conformance import (build_env, decision_events,
+                                                jitted_decision_events,
+                                                run_recorded_episode)
     from ddls_tpu.telemetry import flight
 
     for b in (args.backend_a, args.backend_b):
@@ -208,20 +86,19 @@ def cmd_run(args) -> int:
               "backend (--backend-a host)", file=sys.stderr)
         return 2
 
-    dataset = args.dataset
-    if dataset is None:
-        from ddls_tpu.graphs.synthetic import generate_pipedream_txt_files
+    try:
+        spec = get_spec(args.scenario)
+    except Exception as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
 
-        dataset = tempfile.mkdtemp(prefix="trace_diff_jobs_")
-        generate_pipedream_txt_files(dataset, n_cnn=2, n_translation=1,
-                                     seed=0, min_ops=4, max_ops=6)
-
-    env_a = make_env(dataset, args.backend_a, args.sim_seconds)
+    env_a = build_env(spec, args.backend_a, dataset_dir=args.dataset,
+                      sim_seconds=args.sim_seconds)
     events_a, actions = run_recorded_episode(
         env_a, args.seed, max_decisions=args.max_decisions,
         detail=args.detail)
-    print(f"backend A ({args.backend_a}): {len(events_a)} events over "
-          f"{len(actions)} decisions")
+    print(f"scenario {spec.name}: backend A ({args.backend_a}): "
+          f"{len(events_a)} events over {len(actions)} decisions")
     if args.save_a:
         flight.save_jsonl(args.save_a, events_a)
 
@@ -230,9 +107,11 @@ def cmd_run(args) -> int:
         b = jitted_decision_events(env_a, events_a, actions)
         rtol = args.rtol if args.rtol is not None else 1e-9
     else:
-        env_b = make_env(dataset, args.backend_b, args.sim_seconds)
+        env_b = build_env(spec, args.backend_b, dataset_dir=args.dataset,
+                          sim_seconds=args.sim_seconds)
         events_b, _ = run_recorded_episode(
-            env_b, args.seed, actions=actions, detail=args.detail)
+            env_b, args.seed, actions=actions,
+            max_decisions=args.max_decisions, detail=args.detail)
         print(f"backend B ({args.backend_b}): {len(events_b)} events")
         if args.save_b:
             flight.save_jsonl(args.save_b, events_b)
@@ -275,12 +154,16 @@ def main(argv=None) -> int:
     run.add_argument("--backend-a", default="host", choices=HOST_BACKENDS)
     run.add_argument("--backend-b", default="native",
                      choices=HOST_BACKENDS + ("jitted",))
+    run.add_argument("--scenario", default="canonical",
+                     help="scenario registry name or spec-JSON path "
+                          "(ddls_tpu/scenarios; default: canonical)")
     run.add_argument("--seed", type=int, default=0)
     run.add_argument("--dataset", default=None,
-                     help="graph-file dir (default: synthesize a small "
-                          "deterministic set)")
-    run.add_argument("--sim-seconds", type=float, default=2e4,
-                     help="simulated episode horizon")
+                     help="graph-file dir (default: the spec's "
+                          "deterministic synthetic set)")
+    run.add_argument("--sim-seconds", type=float, default=None,
+                     help="simulated episode horizon (default: the "
+                          "spec's own, canonical 2e4)")
     run.add_argument("--max-decisions", type=int, default=500)
     run.add_argument("--detail", action="store_true",
                      help="record per-op/flow lookahead detail events")

@@ -645,7 +645,13 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
                  memo_surface=("'lookahead_key_for'",
                                "'_assemble_lookahead_key'"),
                  memo_trace_keys=("'memo_hits'",),
-                 memo_extra=""):
+                 memo_extra="",
+                 failure_map=("FAILURE_PREEMPT: 'worker_preempted', "
+                              "FAILURE_STRAGGLE: 'channel_degraded'"),
+                 flight_kinds=("'worker_preempted'",
+                               "'channel_degraded'"),
+                 host_emits=("'worker_preempted'",
+                             "'channel_degraded'")):
     jax_env = (
         "CAUSE_QUEUE_FULL = 0\n"
         "CAUSE_MOUNTED = 1\n"
@@ -655,6 +661,7 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
         "def make_segment_fn():\n"
         "    trace = {'ep_ret': 0, 'action': 1, 'memo_hits': 2}\n")
     host = ("HOST_CAUSES = (" + ", ".join(host_strings) + ")\n"
+            "HOST_EMITS = (" + ", ".join(host_emits) + ",)\n"
             + "".join(f"def {fn}():\n    pass\n" for fn in host_key_fns))
     ppo = ("def collect(trace):\n"
            "    r = trace['ep_ret']\n"
@@ -666,13 +673,19 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
     memo = ("HOST_KEY_SURFACE = (" + ", ".join(memo_surface) + ",)\n"
             "MEMO_TRACE_KEYS = (" + ", ".join(memo_trace_keys) + ",)\n"
             + memo_extra)
+    failures = ("FAILURE_PREEMPT = 0\n"
+                "FAILURE_STRAGGLE = 1\n"
+                "FAILURE_KIND_TO_EVENT = {" + failure_map + "}\n")
+    flight = "EVENT_KINDS = (" + ", ".join(flight_kinds) + ",)\n"
     return {"jax_env.py": jax_env, "cluster.py": host, "ppo.py": ppo,
-            "rollout.py": rollout, "jax_memo.py": memo}
+            "rollout.py": rollout, "jax_memo.py": memo,
+            "failures.py": failures, "flight.py": flight}
 
 
 PARITY_CFG = {"backend-surface-parity": {
     "jax_env": "jax_env.py", "ppo_device": "ppo.py",
     "rollout": "rollout.py", "jax_memo": "jax_memo.py",
+    "failures": "failures.py", "flight": "flight.py",
     "host_cause_files": ["cluster.py"],
     "jitted_only_causes": []}}
 
@@ -785,6 +798,36 @@ def test_backend_parity_memo_surface_moved_fires(tmp_path):
     msgs = [f.message for f in errors_of(res, "backend-surface-parity")]
     assert any("HOST_KEY_SURFACE" in m and "moved" in m for m in msgs)
     assert any("MEMO_TRACE_KEYS" in m and "moved" in m for m in msgs)
+
+
+def test_backend_parity_failure_map_nonbijective_fires(tmp_path):
+    # a FAILURE_* kind code with no event mapping (ISSUE 16): adding a
+    # failure kind without naming its flight event must fail at lint
+    files = parity_files(
+        failure_map="FAILURE_PREEMPT: 'worker_preempted'")
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("FAILURE_KIND_TO_EVENT is not a bijection" in f.message
+               and "FAILURE_STRAGGLE" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_failure_event_not_in_flight_kinds_fires(tmp_path):
+    files = parity_files(flight_kinds=("'worker_preempted'",))
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("'channel_degraded'" in f.message
+               and "EVENT_KINDS" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_failure_event_no_host_emission_fires(tmp_path):
+    files = parity_files(host_emits=("'worker_preempted'",))
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("'channel_degraded'" in f.message
+               and "no host emission site" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
 
 
 def test_backend_parity_missing_memo_file_is_flagged(tmp_path):
