@@ -943,12 +943,11 @@ def run_jaxenv_bench(args) -> dict:
     memo_m = int(np.asarray(out["memo_misses"]))
     memo_e = int(np.asarray(out["memo_evicts"]))
 
-    # memo off for the vmapped lanes: under vmap the probe's lax.cond
-    # lowers to select and computes both branches — correct but inert
-    # (sim/jax_memo.py), so the 8-lane aggregate measures the plain
-    # kernel rather than paying dead probe overhead
-    vfn = jax.jit(jax.vmap(make_episode_fn(et, memo_cfg=None),
-                           in_axes=(0, 0)))
+    # wide memo ON for the vmapped lanes (the make_episode_fn default,
+    # ISSUE 17): the batched probe masks hit lanes out of the lookahead
+    # while_loop — the 8-lane aggregate now measures the memo-served
+    # kernel, the same contract as the single-lane line above
+    vfn = jax.jit(jax.vmap(make_episode_fn(et), in_axes=(0, 0)))
     banks = [mk_bank(s) for s in range(8)]
     bb = {k: jnp.stack([b[k] for b in banks]) for k in banks[0]}
     aa = jnp.broadcast_to(actions, (8, D))
@@ -957,6 +956,12 @@ def run_jaxenv_bench(args) -> dict:
     with telemetry.span("bench.vmap8") as vmap_span:
         vout = jax.block_until_ready(vfn(bb, aa))
     vdec = int(np.asarray(vout["trace"][5]).sum())
+    # lane-summed memo counters of the timed vmap8 episode batch, from
+    # the same already-fetched episode outputs (ONE reporting-boundary
+    # drain, never per step/lane)
+    v_h = int(np.asarray(vout["memo_hits"]).sum())
+    v_m = int(np.asarray(vout["memo_misses"]).sum())
+    v_e = int(np.asarray(vout["memo_evicts"]).sum())
 
     return {
         "metric": "jaxenv_decisions_per_sec",
@@ -972,6 +977,9 @@ def run_jaxenv_bench(args) -> dict:
         "memo": {"hits": memo_h, "misses": memo_m, "evicts": memo_e,
                  "hit_rate": round(memo_h / (memo_h + memo_m), 4)
                  if memo_h + memo_m else 0.0},
+        "vmap8_memo": {"hits": v_h, "misses": v_m, "evicts": v_e,
+                       "hit_rate": round(v_h / (v_h + v_m), 4)
+                       if v_h + v_m else 0.0},
         "telemetry": telemetry.snapshot(),
     }
 
@@ -1750,10 +1758,12 @@ def run_bench(args, platform_note: str | None,
                 args.fused_updates_per_epoch
             mode_results[mode]["autotune"] = fused_autotune.as_dict()
         if mode == "fused" and fused_driver is not None:
-            # ISSUE-13 artifact field: the in-kernel lookahead memo's
-            # cumulative hit/miss/evict counts + hit rate — ONE fetch
-            # here at the reporting boundary (counters ride the carried
-            # device state; None when lanes > 1 left the memo off)
+            # ISSUE-13/17 artifact field: the in-kernel lookahead
+            # memo's cumulative hit/miss/evict counts + hit rate,
+            # summed over lanes — ONE fetch here at the reporting
+            # boundary (counters ride the carried device state; the
+            # wide probe keeps the memo ON at every lane count, so
+            # multi-lane fused lines carry the block too)
             memo = fused_driver.memo_counters()
             if memo is not None:
                 memo["hit_rate"] = round(memo["hit_rate"], 4)
@@ -2114,7 +2124,7 @@ def main(argv=None) -> int:
                              "jitted epoch dispatch. Raising it "
                              "amortises the per-dispatch tunnel RTT on "
                              "the TPU; on CPU the dispatch is ~free and "
-                             "each extra scan round costs ~10% "
+                             "each extra scan round costs ~10%% "
                              "(docs/perf_round8.md), so the smoke "
                              "default stays 1")
     parser.add_argument("--fused-lanes", type=int, default=0,

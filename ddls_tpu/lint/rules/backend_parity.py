@@ -1,7 +1,7 @@
 """backend-surface-parity: the host<->jitted decision surfaces must stay
 in sync — checked by AST compare, no jax import.
 
-Three cheap cross-file compares over the shared parse (CLAUDE.md
+Six cheap cross-file compares over the shared parse (CLAUDE.md
 four-backend invariant, tests pin the VALUES — this rule pins the
 SURFACES so a rename fails at lint time, not at the first x64 parity
 run):
@@ -29,7 +29,16 @@ run):
    (``MEMO_TRACE_KEYS``) must be traced by ``make_segment_fn``, so the
    counters drain with the episode counters rather than silently
    vanishing from the compact trace.
-5. The scenario failure-event vocabulary (``scenarios/failures.py``,
+5. The wide-probe masking surface (``sim/jax_memo.py``, ISSUE 17): the
+   batched memo probe masks hit lanes out of the lookahead while_loop
+   through the entry point + keyword the memo declares in
+   ``WIDE_PROBE_SURFACE`` — the named function must still exist in
+   ``sim/jax_lookahead.py`` with the named parameter, and
+   ``sim/jax_env.py`` must still forward that keyword at a call site.
+   Losing the forward would not fail any parity test (an unmasked
+   probe is correct, just inert) — it would silently re-run the full
+   while_loop on every memo-hit lane.
+6. The scenario failure-event vocabulary (``scenarios/failures.py``,
    ISSUE 16): the ``FAILURE_*`` kind codes pairwise distinct,
    ``FAILURE_KIND_TO_EVENT`` a bijection over them, and every event
    string present in BOTH backend vocabularies — the flight recorder's
@@ -51,6 +60,7 @@ DEFAULT_PATHS = {
     "ppo_device": "ddls_tpu/rl/ppo_device.py",
     "rollout": "ddls_tpu/rl/rollout.py",
     "jax_memo": "ddls_tpu/sim/jax_memo.py",
+    "jax_lookahead": "ddls_tpu/sim/jax_lookahead.py",
     "failures": "ddls_tpu/scenarios/failures.py",
     "flight": "ddls_tpu/telemetry/flight.py",
     "host_cause_files": ["ddls_tpu/sim/cluster.py",
@@ -106,9 +116,10 @@ class BackendSurfaceParityRule(Rule):
                "constants, host cause strings in sim/cluster.py//"
                "sim/actions.py, make_segment_fn's ep_* trace keys in "
                "sync with rl/ppo_device.py + rollout.py's "
-               "harvest_episode_record keys, and scenarios/failures.py's "
+               "harvest_episode_record keys, scenarios/failures.py's "
                "FAILURE_KIND_TO_EVENT events in flight EVENT_KINDS + "
-               "cluster.py literals")
+               "cluster.py literals, and jax_memo's WIDE_PROBE_SURFACE "
+               "bound to sim/jax_lookahead.py + forwarded by jax_env.py")
     scope_dirs = ()  # tree-level rule: no per-file pass
 
     def in_scope(self, rel: str) -> bool:
@@ -131,6 +142,7 @@ class BackendSurfaceParityRule(Rule):
         ppo_device = _get_sf(ctx, str(paths["ppo_device"]))
         rollout = _get_sf(ctx, str(paths["rollout"]))
         jax_memo = _get_sf(ctx, str(paths["jax_memo"]))
+        jax_lookahead = _get_sf(ctx, str(paths["jax_lookahead"]))
         failures = _get_sf(ctx, str(paths["failures"]))
         flight = _get_sf(ctx, str(paths["flight"]))
         host_files = [_get_sf(ctx, str(p))
@@ -139,6 +151,7 @@ class BackendSurfaceParityRule(Rule):
                          (paths["ppo_device"], ppo_device),
                          (paths["rollout"], rollout),
                          (paths["jax_memo"], jax_memo),
+                         (paths["jax_lookahead"], jax_lookahead),
                          (paths["failures"], failures),
                          (paths["flight"], flight)]
                         + list(zip(paths["host_cause_files"],
@@ -166,6 +179,11 @@ class BackendSurfaceParityRule(Rule):
                 and host_files[0].tree is not None):
             findings.extend(self._check_memo_surface(
                 jax_memo, host_files[0], jax_env))
+        if (jax_memo is not None and jax_memo.tree is not None
+                and jax_lookahead is not None
+                and jax_lookahead.tree is not None):
+            findings.extend(self._check_wide_probe_surface(
+                jax_memo, jax_lookahead, jax_env))
         if all(sf is not None and sf.tree is not None
                for sf in (failures, flight)) \
                 and host_files and host_files[0] is not None \
@@ -310,6 +328,81 @@ class BackendSurfaceParityRule(Rule):
                     "make_segment_fn (nor emitted by "
                     "memo_trace_counters) — memo counters would not "
                     "drain with the episode counters"))
+        return findings
+
+    # ------------------------------------------------ wide-probe surface
+    def _check_wide_probe_surface(self, jax_memo: SourceFile,
+                                  jax_lookahead: SourceFile,
+                                  jax_env: Optional[SourceFile],
+                                  ) -> List[Finding]:
+        """The batched memo probe's hit-lane masking contract (ISSUE
+        17): ``WIDE_PROBE_SURFACE = (entry_fn, keyword)`` in
+        sim/jax_memo.py names the lookahead entry point and the masking
+        keyword — the function must still exist in sim/jax_lookahead.py
+        with that parameter, and sim/jax_env.py must still forward the
+        keyword at a call site. An unmasked probe is CORRECT but inert
+        (both memo branches run under vmap), so no parity test catches
+        the drift — only this surface check does."""
+        findings: List[Finding] = []
+        surface: List[str] = []
+        line = 1
+        for node in jax_memo.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            if (isinstance(target, ast.Name)
+                    and target.id == "WIDE_PROBE_SURFACE"
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                surface = [e.value for e in node.value.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str)]
+                line = node.lineno
+        if len(surface) != 2:
+            return [Finding(
+                self.id, jax_memo.rel, line,
+                "could not locate the WIDE_PROBE_SURFACE (entry_fn, "
+                "keyword) tuple — the wide-probe masking surface moved; "
+                "update backend-surface-parity")]
+        fn_name, kw_name = surface
+
+        fn = _function(jax_lookahead.tree, fn_name)
+        if fn is None:
+            findings.append(Finding(
+                self.id, jax_memo.rel, line,
+                f"WIDE_PROBE_SURFACE names {fn_name!r} but no such "
+                f"function exists in {jax_lookahead.rel} — the masked "
+                "lookahead entry point moved without the memo mirror"))
+        else:
+            params = {a.arg for a in (fn.args.args + fn.args.kwonlyargs
+                                      + fn.args.posonlyargs)}
+            if kw_name not in params:
+                findings.append(Finding(
+                    self.id, jax_lookahead.rel, fn.lineno,
+                    f"{fn_name}() has no {kw_name!r} parameter — the "
+                    "batched memo probe's hit-lane mask "
+                    "(WIDE_PROBE_SURFACE) has nothing to bind to"))
+
+        if jax_env is None or jax_env.tree is None:
+            return findings
+        forwarded = False
+        for node in ast.walk(jax_env.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            name = (callee.id if isinstance(callee, ast.Name)
+                    else callee.attr if isinstance(callee, ast.Attribute)
+                    else None)
+            if name == fn_name and any(k.arg == kw_name
+                                       for k in node.keywords):
+                forwarded = True
+                break
+        if not forwarded:
+            findings.append(Finding(
+                self.id, jax_env.rel, 1,
+                f"no call to {fn_name}() in {jax_env.rel} forwards "
+                f"{kw_name}= — memo-hit lanes would re-run the full "
+                "lookahead while_loop (correct but inert; the wide "
+                "probe's masking is the speedup)"))
         return findings
 
     # ------------------------------------------------- failure vocabulary

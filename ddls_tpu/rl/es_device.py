@@ -61,10 +61,11 @@ def train_es_on_device(et, ot, model, learner, params,
 
     from ddls_tpu.sim.jax_env import make_policy_episode_fn
 
-    # memo off: the generation vmaps the episode over the population, so
-    # the memo's probe cond would lower to select and compute both
-    # branches — correct but pure overhead (sim/jax_memo.py vmap hazard)
-    episode_fn = make_policy_episode_fn(et, ot, model, memo_cfg=None)
+    # wide memo ON (the make_policy_episode_fn default): the generation
+    # vmaps the episode over the population and the batched probe masks
+    # hit lanes out of the lookahead while_loop — every population
+    # member carries its own table and hits its cache (ISSUE 17)
+    episode_fn = make_policy_episode_fn(et, ot, model)
     generation_fn = make_generation_fn(episode_fn, learner)
     state = learner.init_state(params)
     rng = jax.random.PRNGKey(seed)

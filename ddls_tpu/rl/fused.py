@@ -254,9 +254,8 @@ def memo_table_cells(et, memo_cfg) -> int:
     embedded as program literals. The memo TABLE itself is a carried
     ARGUMENT, not a constant — it costs argument traffic and HBM, not
     serialized-program bytes. ``memo_cfg`` is the knob value ("auto" /
-    MemoConfig / None); "auto" counts the cells because the size model
-    must upper-bound the lanes=1 candidate, where auto turns the memo
-    on."""
+    MemoConfig / None); "auto" counts the cells because it turns the
+    memo on at every lane count (the wide-vmap probe, ISSUE 17)."""
     if memo_cfg is None:
         return 0
     return 1 + int(et.pads.n_ops) + 2 * int(et.pads.n_deps)
@@ -617,10 +616,11 @@ class FusedEpochDriver:
         self.mesh = mesh
         self.env_steps_per_epoch = (self.updates_per_epoch
                                     * self.segment_len * self.num_lanes)
-        # in-kernel lookahead memo: "auto" enables it only at lanes=1 —
-        # the regime where the probe's lax.cond short-circuits (and the
-        # axon-preferred few-lanes x long-segments shape); the table
-        # rides the carried sim state across epochs like the rest of it
+        # in-kernel lookahead memo: "auto" enables it at every lane
+        # count (the batched probe masks hit lanes out of the lookahead
+        # while_loop — sim/jax_memo.py, ISSUE 17); each lane carries its
+        # own table, riding the carried sim state across epochs like
+        # the rest of it
         self.memo_cfg = resolve_memo_cfg(memo_cfg, self.num_lanes)
         T, B, U = self.segment_len, self.num_lanes, self.updates_per_epoch
         # trace_obs: the in-scan update carry — the update consumes the

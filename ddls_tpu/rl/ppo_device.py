@@ -34,12 +34,12 @@ class DevicePPOCollector:
     ``num_envs`` divisible by the dp axis size.
 
     ``memo_cfg`` wires the in-kernel lookahead memo (sim/jax_memo.py):
-    ``"auto"`` (default) enables it only at num_envs=1 — the lanes=1
-    regime where the probe's lax.cond short-circuits; under a multi-lane
-    vmap the cond lowers to select and the memo is inert (correct, never
-    faster), so auto keeps it off there. Memo hit/miss counters ride the
-    per-collect trace and ``memo_counters()`` exposes the cumulative
-    totals (drain boundaries only)."""
+    ``"auto"`` (default) enables it at EVERY lane count — the batched
+    probe masks hit lanes out of the lookahead while_loop, so the
+    vmapped lanes hit their own per-lane tables too (ISSUE 17). Memo
+    hit/miss counters ride the per-collect trace and
+    ``memo_counters()`` exposes the cumulative totals summed over lanes
+    (drain boundaries only)."""
 
     def __init__(self, et, ot, model, banks: Dict, rollout_length: int,
                  mesh=None, memo_cfg="auto"):

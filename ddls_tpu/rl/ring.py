@@ -60,6 +60,19 @@ Segment lifecycle/unlink safety is delegated to ``SlabSet`` (each
 segment carries its own ``weakref.finalize`` crash fallback), so an
 interrupted run leaves no ``/dev/shm`` litter; the lint engine's
 ``shm-unlink`` rule covers the creates in rl/shm.py.
+
+DEVICE MODE (round 12, rl/sebulba.py): ``TrajRing(fields=None, ...)``
+builds SLAB-LESS segments — no shm, ``views`` empty — for the Sebulba
+actor→learner device queue, where a "segment" is one in-flight
+device-resident batch rather than host memory. The ledger, the lease
+backpressure, and the two-phase token protocol carry over UNCHANGED:
+with no host views the alias probe trivially verdicts "copied"
+(``staged_aliases`` over zero address ranges), so the phase-1 token is
+the tree ``device_put`` onto the learner sub-mesh — ready exactly when
+the device-to-device transfer completes — and phase 2's unconditional
+update-output token still covers donating backends deleting the staged
+buffers at dispatch. Worker-attach surfaces (``specs``,
+``segment_names``) reject loudly in this mode.
 """
 from __future__ import annotations
 
@@ -138,12 +151,14 @@ def staged_aliases(staged, views: Dict[str, np.ndarray]) -> bool:
 
 
 class RingSegment:
-    """One ``[rows, B, ...]`` slab plus its ledger entry."""
+    """One ``[rows, B, ...]`` slab plus its ledger entry. ``slabs=None``
+    is a DEVICE-MODE segment (see module docstring): pure ledger entry
+    for one in-flight device batch, no host memory, empty ``views``."""
 
     __slots__ = ("index", "slabs", "state", "release_token", "aliased",
                  "generation")
 
-    def __init__(self, index: int, slabs: SlabSet):
+    def __init__(self, index: int, slabs: Optional[SlabSet]):
         self.index = index
         self.slabs = slabs
         self.state = "free"
@@ -157,7 +172,7 @@ class RingSegment:
 
     @property
     def views(self) -> Dict[str, np.ndarray]:
-        return self.slabs.views
+        return self.slabs.views if self.slabs is not None else {}
 
 
 class TrajRing:
@@ -170,19 +185,23 @@ class TrajRing:
     One condition variable serialises the ledger.
     """
 
-    def __init__(self, fields: Dict[str, Tuple[Tuple[int, ...], np.dtype]],
+    def __init__(self,
+                 fields: Optional[Dict[str, Tuple[Tuple[int, ...],
+                                                  np.dtype]]],
                  rows: int, num_envs: int, segments: int):
         if segments < 2:
             raise ValueError(
                 f"a trajectory ring needs >= 2 segments, got {segments}")
         self.rows = int(rows)
         self.num_envs = int(num_envs)
-        self.fields = dict(fields)
+        # fields=None: device mode — slab-less ledger-only segments
+        self.fields = dict(fields) if fields is not None else None
         self.segments: List[RingSegment] = []
         try:
             for i in range(segments):
                 self.segments.append(RingSegment(
-                    i, SlabSet(fields, rows=rows, num_envs=num_envs)))
+                    i, None if fields is None
+                    else SlabSet(fields, rows=rows, num_envs=num_envs)))
         except Exception:
             self.close()
             raise
@@ -381,9 +400,17 @@ class TrajRing:
     # ---------------------------------------------------------- lifecycle
     def specs(self) -> List[list]:
         """Per-segment slab specs for the workers' ring attach."""
+        if self.fields is None:
+            raise RuntimeError(
+                "device-mode trajectory ring has no slabs: worker "
+                "attach (specs) is a shm-ring surface only")
         return [seg.slabs.spec() for seg in self.segments]
 
     def segment_names(self) -> List[str]:
+        if self.fields is None:
+            raise RuntimeError(
+                "device-mode trajectory ring has no slabs: worker "
+                "attach (segment_names) is a shm-ring surface only")
         return [name for seg in self.segments
                 for name in seg.slabs.segment_names()]
 
@@ -391,4 +418,5 @@ class TrajRing:
         """Unlink every segment (idempotent); each SlabSet's own
         ``weakref.finalize`` covers paths that never reach here."""
         for seg in self.segments:
-            seg.slabs.close()
+            if seg.slabs is not None:
+                seg.slabs.close()

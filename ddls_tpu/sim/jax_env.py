@@ -58,11 +58,12 @@ from ddls_tpu.sim import jax_memo
 from ddls_tpu.sim.partition import partition_graph, partitioned_op_id
 
 #: episode-kernel default: the in-kernel lookahead memo (sim/jax_memo.py)
-#: is ON for the single-lane episode builders — memoised and recomputed
-#: lookaheads are bitwise identical by construction, so the x64 parity
-#: suites run with it enabled unchanged. Multi-lane vmap callers pass
-#: ``memo_cfg=None`` (under vmap the probe's lax.cond lowers to select
-#: and both branches run — correct but inert, pure overhead).
+#: is ON for the episode builders at EVERY lane count — memoised and
+#: recomputed lookaheads are bitwise identical by construction, so the
+#: x64 parity suites run with it enabled unchanged, and the batched
+#: probe masks hit lanes out of the lookahead while_loop so multi-lane
+#: vmap callers (es_device, bench vmap8) hit the cache too (ISSUE 17;
+#: each vmapped lane carries its own table).
 DEFAULT_EPISODE_MEMO = jax_memo.MemoConfig()
 
 Coord = Tuple[int, int, int]
@@ -1046,7 +1047,10 @@ def _episode_kernels(et: EpisodeTables):
         from ddls_tpu.sim.jax_lookahead import jax_lookahead
         op_valid = et.tables["op_valid"][cfg]
 
-        def run_lookahead():
+        def run_lookahead(skip=None):
+            # ``skip`` is the memo probe's hit mask, threaded into the
+            # lookahead while_loop cond (jax_memo.WIDE_PROBE_SURFACE) so
+            # hit lanes contribute zero trips to the batched loop
             t_la, _, _, _, ok = jax_lookahead(
                 et.tables["op_compute"][cfg], op_valid,
                 jnp.where(op_valid, ots, -1), op_score,
@@ -1054,7 +1058,7 @@ def _episode_kernels(et: EpisodeTables):
                 et.tables["dep_valid"][cfg], et.tables["dep_src"][cfg],
                 et.tables["dep_dst"][cfg], et.tables["dep_mutual"][cfg],
                 is_flow, dep_score, chan[:, None],
-                num_workers=n_srv, num_channels=n_chan)
+                num_workers=n_srv, num_channels=n_chan, skip=skip)
             return t_la, ok
 
         if memo is None:
@@ -1087,10 +1091,12 @@ def _episode_kernels(et: EpisodeTables):
         once, not n_deg times."""
         jtype = bank["type"][row]
         cfgs = jtype * n_deg + jnp.arange(n_deg, dtype=jnp.int32)
-        # memo-less on purpose: under this vmap the probe's lax.cond
-        # would lower to select and compute both branches anyway
-        # (sim/jax_memo.py vmap hazard) — the host counterpart keeps
-        # candidate pricing fast through its own prefetch instead
+        # memo-less on purpose: this vmap batches the CFG axis within
+        # one env, whose single memo table cannot absorb n_deg scattered
+        # insertions through an in_axes=None carry (the wide probe
+        # batches over LANES, each with its own table) — the host
+        # counterpart keeps candidate pricing fast through its own
+        # prefetch instead
         ev, _ = jax.vmap(eval_cfg, in_axes=(None, None, None, 0))(
             bank, carry, row, cfgs)
         return (ev["ok_place"] & ev["ok_chan"] & ev["engine_ok"],
@@ -1245,10 +1251,11 @@ def make_episode_fn(et: EpisodeTables,
 
     The in-kernel lookahead memo (``memo_cfg``, sim/jax_memo.py) rides
     the scan carry and defaults ON — hits and recomputes are bitwise
-    identical, so results never depend on it. Pass ``memo_cfg=None``
-    when vmapping this kernel (the probe cond lowers to select under
-    vmap: correct but inert). With the memo on, the output dict carries
-    the final ``memo_hits``/``memo_misses``/``memo_evicts`` counters.
+    identical, so results never depend on it, and the batched probe
+    stays effective under vmap (hit lanes are masked out of the
+    lookahead while_loop; each lane carries its own table). With the
+    memo on, the output dict carries the final
+    ``memo_hits``/``memo_misses``/``memo_evicts`` counters.
     """
     import jax
     import jax.numpy as jnp
@@ -1457,8 +1464,9 @@ def make_policy_episode_fn(et: EpisodeTables, ot: dict, model,
     then executes the decision + event clock exactly like
     `make_episode_fn`. ONE device dispatch per episode — the complete
     §5.8 HBM-resident rollout shape; vmap over (bank, rng) for batched
-    collection (pass ``memo_cfg=None`` there: under vmap the memo's
-    probe cond lowers to select and is inert — sim/jax_memo.py)."""
+    collection (the memo stays ON there: the batched probe masks hit
+    lanes out of the lookahead while_loop and each lane carries its own
+    table — sim/jax_memo.py, ISSUE 17)."""
     import jax
     import jax.numpy as jnp
 
@@ -1611,9 +1619,10 @@ def make_segment_fn(et: EpisodeTables, ot: dict, model, n_steps: int,
     the memo — the exact mirror of the host ``cluster.lookahead_cache``
     persisting across ``reset()`` under an unchanged workload signature
     (each lane replays one fixed bank, so its signature never changes).
-    Enable only for single-lane use (``jax_memo.resolve_memo_cfg``):
-    under a multi-lane vmap the probe cond lowers to select and the
-    memo is inert.
+    Effective at EVERY lane count (``jax_memo.resolve_memo_cfg``'s
+    "auto" enables it everywhere): under a multi-lane vmap the batched
+    probe masks hit lanes out of the lookahead while_loop and each lane
+    carries its own table.
 
     ``trace_obs=True`` additionally carries the FULL observation dict the
     in-scan policy forward consumed (``trace["obs"]``) — the in-scan
@@ -1796,7 +1805,9 @@ def make_oracle_episode_fn(et: EpisodeTables, ot: dict,
     the smallest valid degree, else 0 — exactly
     `envs/baselines.py:OracleJCT.compute_action`), then run the decision
     and event clock. (bank) -> traces. The memo serves the DECISION's
-    lookahead only (candidate pricing stays vmapped — memo inert there).
+    lookahead only (candidate pricing vmaps the cfg axis within one
+    env, whose single table cannot take the scattered insertions — see
+    `price_all`).
     """
     import jax
     import jax.numpy as jnp

@@ -313,7 +313,7 @@ def build_native_lookahead_arrays(cluster, job,
 def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
                   dep_remaining, dep_valid, dep_src, dep_dst, dep_mutual,
                   dep_is_flow, dep_score, dep_channel,
-                  *, num_workers: int, num_channels: int):
+                  *, num_workers: int, num_channels: int, skip=None):
     """One-training-step lookahead; returns (t, comm_oh, comp_oh, busy, ok).
 
     ``busy`` is the worker-busy time integral (sum over ticks of
@@ -321,6 +321,17 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
     mounted-worker count x step time. Pure function of arrays —
     jit/vmap-friendly. ``ok`` is False when the engine could not progress
     (the host raises in that case).
+
+    ``skip`` (optional bool scalar) masks the while_loop cond: a True
+    lane exits before its first body iteration and returns the (garbage)
+    init accumulators — the memo probe's wide-vmap lever
+    (sim/jax_memo.py): jax batches ``lax.while_loop`` to run while ANY
+    lane's cond holds, select-freezing finished lanes, so seeding
+    memo-HIT lanes with ``skip=True`` makes the batched loop run exactly
+    to the max trip count over MISS lanes (zero when every lane hit).
+    Miss lanes iterate under their own cond regardless of neighbours, so
+    their results stay bit-identical to an unbatched run. ``None`` (the
+    default) traces the historical unmasked cond byte-for-byte.
     """
     import jax
     import jax.numpy as jnp
@@ -340,7 +351,8 @@ def jax_lookahead(op_remaining, op_valid, op_worker, op_score, num_parents,
         (_, _, op_done, dep_done, _, _, _, _, _, it, stuck) = state
         all_done = (jnp.all(op_done | ~op_valid)
                     & jnp.all(dep_done | ~dep_valid))
-        return (~all_done) & (it < max_iters) & (~stuck)
+        live = (~all_done) & (it < max_iters) & (~stuck)
+        return live if skip is None else live & ~skip
 
     def body(state):
         (rem_op, rem_dep, op_done, dep_done, parent_done,

@@ -45,12 +45,20 @@ SAME compiled ``jax_lookahead`` on bit-identical inputs, so memo-on and
 memo-off episodes are indistinguishable in any precision mode — the x64
 full-episode parity suites run with the memo enabled unchanged.
 
-vmap hazard (documented per ISSUE 13): under a multi-lane ``vmap`` the
-probe's ``lax.cond`` lowers to ``select`` and BOTH branches execute —
-the memoised lookahead is still computed on hits, so the memo is
-correct but INERT (pure overhead) there. ``resolve_memo_cfg`` therefore
-defaults the memo on only for lanes=1, the regime that matters on the
-tunnelled TPU anyway (round 4: few lanes x long segments).
+Wide-vmap probe (ISSUE 17): the probe is BATCHED, not branched. Each
+lane gathers its hit value from its own table, then the lookahead runs
+with the hit flag masked into its ``while_loop`` cond
+(``jax_lookahead(..., skip=hit)``) and the result is where-selected
+against the stored value. jax batches ``lax.while_loop`` to run while
+ANY lane's cond holds (select-freezing finished lanes), so under a
+multi-lane ``vmap`` the loop trips exactly to the max count over MISS
+lanes — zero when every lane hits — and the per-lane ``.at[].set``
+insertions scatter back through vmap's batching rule. The lanes=1
+canonical 13x therefore generalises to every width, and
+``resolve_memo_cfg``'s ``"auto"`` enables the memo at ALL widths
+(es_device, bench vmap8, multi-lane fused/collector lanes). Miss lanes
+iterate under their own cond regardless of neighbours, so memo-on and
+memo-off stay bit-identical at every width.
 
 Persistence: the table rides the scan carry OUTSIDE the in-kernel
 episode reset (`make_segment_fn` resets the env state to ``fresh`` but
@@ -77,6 +85,16 @@ HOST_KEY_SURFACE = ("lookahead_key_for", "_assemble_lookahead_key")
 #: sync boundaries, never fetched per step).
 MEMO_TRACE_KEYS = ("memo_hits", "memo_misses", "memo_evicts")
 
+#: the wide-probe surface: the batched probe is only effective under
+#: vmap while the hit flag keeps reaching the lookahead while_loop's
+#: cond — ``memo_lookahead`` hands ``hit`` to ``compute(hit)`` and the
+#: env's ``run_lookahead`` forwards it as the named keyword of the
+#: named ``sim/jax_lookahead.py`` function. The lint engine's
+#: backend-surface-parity rule pins both ends (a rename or a dropped
+#: mask fails at lint time instead of silently reverting every
+#: multi-lane caller to inert-memo behaviour).
+WIDE_PROBE_SURFACE = ("jax_lookahead", "skip")
+
 
 @dataclasses.dataclass(frozen=True)
 class MemoConfig:
@@ -92,12 +110,17 @@ class MemoConfig:
 def resolve_memo_cfg(memo_cfg: Union[str, MemoConfig, None],
                      n_lanes: int) -> Optional[MemoConfig]:
     """The ONE resolution home for the ``use_jax_lookahead_memo`` knob:
-    ``"auto"`` enables the memo only at lanes=1 (where ``lax.cond``
-    actually short-circuits — under multi-lane vmap the cond lowers to
-    select, both branches run, and the memo is inert), an explicit
-    MemoConfig/None forces it on/off."""
+    ``"auto"`` enables the memo at EVERY lane count — the batched probe
+    masks hit lanes out of the lookahead while_loop, so wide-vmap lanes
+    hit the cache too (ISSUE 17; the historical lanes=1-only auto
+    predates the mask, when the cond probe was select-inert under
+    vmap). An explicit MemoConfig/None still forces it on/off;
+    ``n_lanes`` stays in the signature as the callers' resolution
+    context (geometry may key on it later)."""
     if memo_cfg == "auto":
-        return MemoConfig() if n_lanes == 1 else None
+        if n_lanes < 1:
+            raise ValueError(f"n_lanes must be >= 1, got {n_lanes}")
+        return MemoConfig()
     if memo_cfg is None or isinstance(memo_cfg, MemoConfig):
         return memo_cfg
     raise ValueError(f"memo_cfg must be 'auto', None or a MemoConfig, "
@@ -176,16 +199,22 @@ def _bits(x):
 
 
 def memo_lookahead(memo: dict, cfg, groups, times,
-                   compute: Callable[[], Tuple]):
+                   compute: Callable[..., Tuple]):
     """Probe-or-compute one lookahead under the memo key (cfg, groups,
     times); returns ``((t, ok), memo')``.
 
-    Probe: hash the key onto a set, compare the FULL residual bitwise
-    against every way; any match serves the stored value through
-    ``lax.cond`` — at lanes=1 the miss branch (the lookahead while-loop)
-    is genuinely skipped. Miss: ``compute()`` runs the lookahead and the
-    (key, value) is inserted at the set's round-robin way (deterministic
-    eviction — same decision stream, same table, every run)."""
+    Probe (batched — the wide-vmap form, ISSUE 17): hash the key onto a
+    set, compare the FULL residual bitwise against every way, gather the
+    matching way's stored value, then call ``compute(hit)`` — the
+    caller must thread the flag into the lookahead while_loop's cond
+    (``jax_lookahead(..., skip=hit)``; :data:`WIDE_PROBE_SURFACE`) so a
+    hit lane exits before its first iteration — and where-select the
+    stored value over the (garbage) masked-out result. At lanes=1 a hit
+    costs one cond evaluation; under a multi-lane vmap the loop trips
+    to the max count over MISS lanes only. Miss: the computed (key,
+    value) is inserted at the set's round-robin way (deterministic
+    eviction — same decision stream, same table, every run; per-lane
+    ``.at[].set`` writes scatter back through vmap batching)."""
     import jax
     import jax.numpy as jnp
 
@@ -213,12 +242,14 @@ def memo_lookahead(memo: dict, cfg, groups, times,
     hit = eq.any()
     way_hit = jnp.argmax(eq).astype(jnp.int32)
 
-    t, ok = jax.lax.cond(
-        hit,
-        lambda _: (memo["val_t"][set_idx, way_hit],
-                   memo["val_ok"][set_idx, way_hit]),
-        lambda _: compute(),
-        operand=None)
+    # batched gather/mask/select: serve the hit value from the table,
+    # run the (skip-masked) lookahead for the miss case, keep whichever
+    # the hit flag says. Bitwise-hit guarantee is preserved at every
+    # width — hits serve previously computed bits verbatim, misses run
+    # the loop under their own cond exactly as unbatched.
+    t_c, ok_c = compute(hit)
+    t = jnp.where(hit, memo["val_t"][set_idx, way_hit], t_c)
+    ok = jnp.where(hit, memo["val_ok"][set_idx, way_hit], ok_c)
 
     # miss insert: round-robin way per set; the write is a pair of
     # where-gated dynamic-update-slices, cheap either way (and dead on

@@ -50,8 +50,8 @@ _RLLIB_TO_PPO = {
 # device_collector flips PPO collection to the jitted in-kernel env,
 # device_bank_jobs sizes its per-lane sampled job banks,
 # use_jax_lookahead_memo gates the in-kernel lookahead memo:
-# "auto" (default) = on for single-lane collection only, True/False
-# force it — sim/jax_memo.py)
+# "auto" (default) = on at every lane count (the wide-vmap batched
+# probe), True/False force it — sim/jax_memo.py)
 _LOOP_LEVEL_ALGO_KEYS = {"num_workers", "device_collector",
                          "device_bank_jobs", "use_jax_lookahead_memo"}
 
@@ -269,6 +269,7 @@ class RLEpochLoop:
                  vec_env_backend: str = "auto",
                  updates_per_epoch: int = 4,
                  fused_config: Optional[dict] = None,
+                 sebulba_config: Optional[dict] = None,
                  path_to_model_cls: Optional[str] = None,  # config parity
                  **kwargs):
         import jax
@@ -287,18 +288,23 @@ class RLEpochLoop:
         self.seed = 0 if seed is None else int(seed)
         self.test_seed = test_seed
 
-        if loop_mode not in ("sequential", "pipelined", "fused"):
+        if loop_mode not in ("sequential", "pipelined", "fused",
+                             "sebulba"):
             raise ValueError(
-                f"loop_mode must be 'sequential', 'pipelined' or "
-                f"'fused', got {loop_mode!r}")
-        if loop_mode == "fused" and not self.SUPPORTS_FUSED:
+                f"loop_mode must be 'sequential', 'pipelined', 'fused' "
+                f"or 'sebulba', got {loop_mode!r}")
+        if (loop_mode in ("fused", "sebulba")
+                and not self.SUPPORTS_FUSED):
+            # SUPPORTS_FUSED gates BOTH in-kernel-collection drivers:
+            # fused (one traced collect→update program) and sebulba
+            # (in-kernel collection on an actor sub-mesh) need the
+            # shared traj contract plus a standalone jitted update
             raise ValueError(
                 f"{type(self).__name__} does not support loop_mode="
-                "'fused': the fused epoch traces collection AND the "
-                "update into one program, which needs in-kernel "
-                "collection plus a pure scan-based update — DQN's "
-                "replay insertion and ES's population fitness step the "
-                "host envs by contract (use ppo/impala/pg, or "
+                f"{loop_mode!r}: the fused/sebulba drivers need "
+                "in-kernel collection plus a jitted scan-based update "
+                "— DQN's replay insertion and ES's population fitness "
+                "step the host envs by contract (use ppo/impala/pg, or "
                 "rl/es_device.py for on-device ES)")
         if loop_mode == "fused" and jax.process_count() > 1:
             raise ValueError(
@@ -307,9 +313,23 @@ class RLEpochLoop:
                 "would need globally-assembled bank/sim-state arrays "
                 "under multi-host (use loop_mode='pipelined' with "
                 "device_collector there)")
+        if loop_mode == "sebulba" and jax.process_count() > 1:
+            raise ValueError(
+                "loop_mode='sebulba' is single-process: the actor/"
+                "learner split partitions the LOCAL devices and hands "
+                "batches over a process-local device ring (use "
+                "loop_mode='pipelined' with device_collector under "
+                "multi-host)")
         self.loop_mode = loop_mode
         self.updates_per_epoch = max(int(updates_per_epoch or 1), 1)
         self.fused_config = dict(fused_config or {})
+        # sebulba runtime state: the sub-mesh split (self.mesh becomes
+        # the LEARNER sub-mesh after _build_sebulba so the update/
+        # checkpoints/eval keep using it) — keys: actor_devices (count,
+        # default half the local devices), ring_segments (default
+        # pipeline_depth + 2)
+        self.sebulba_config = dict(sebulba_config or {})
+        self.actor_mesh = None
         # fused runtime state: the driver, its autotune decision, the
         # undrained compact episode-counter traces, and the chip lock
         # held for the run on accelerator backends
@@ -328,9 +348,11 @@ class RLEpochLoop:
                 "0: collecting against stale params needs an explicit "
                 "off-policy correction (IMPALA's V-trace); ppo/pg/dqn/es "
                 "must collect with the current params (pipeline_depth=0)")
-        if self.pipeline_depth and self.loop_mode != "pipelined":
+        if (self.pipeline_depth
+                and self.loop_mode not in ("pipelined", "sebulba")):
             raise ValueError(
-                "pipeline_depth > 0 requires loop_mode='pipelined'")
+                "pipeline_depth > 0 requires loop_mode='pipelined' or "
+                "'sebulba'")
         if vec_env_backend not in ("auto", "pipe", "shm"):
             raise ValueError(
                 f"vec_env_backend must be 'auto', 'pipe' or 'shm', got "
@@ -360,15 +382,16 @@ class RLEpochLoop:
         # (DQN, ES) reject it loudly in their _build_learner.
         self.device_collector = bool(
             (algo_config or {}).get("device_collector", False))
-        if self.loop_mode == "fused":
-            # fused collection runs the in-kernel env by construction:
-            # the same template-env/bank setup as device_collector
+        if self.loop_mode in ("fused", "sebulba"):
+            # fused/sebulba collection runs the in-kernel env by
+            # construction: the same template-env/bank setup as
+            # device_collector
             self.device_collector = True
         self.device_bank_jobs = (algo_config or {}).get("device_bank_jobs")
-        # the in-kernel lookahead memo knob (ISSUE 13, sim/jax_memo.py):
-        # "auto" resolves to ON only for lanes=1 collection (where the
-        # probe's lax.cond short-circuits; under multi-lane vmap the
-        # cond lowers to select and the memo is inert)
+        # the in-kernel lookahead memo knob (ISSUE 13/17,
+        # sim/jax_memo.py): "auto" resolves to ON at every lane count —
+        # the batched probe masks hit lanes out of the lookahead
+        # while_loop, so multi-lane vmap collection hits the cache too
         self.use_jax_lookahead_memo = (algo_config or {}).get(
             "use_jax_lookahead_memo", "auto")
         if (self.use_jax_lookahead_memo != "auto"
@@ -477,12 +500,19 @@ class RLEpochLoop:
     def _build_learner(self) -> None:
         from ddls_tpu.rl.rollout import RolloutCollector
 
+        if self.loop_mode == "sebulba":
+            # split BEFORE the learner builds: self.mesh becomes the
+            # LEARNER sub-mesh (may fall back to pipelined, loudly)
+            self._split_sebulba_mesh()
         self.learner = self._make_learner()
         self.state = self.learner.init_state(self.params)
         if self.loop_mode == "fused":
             self._build_fused()
             if self.loop_mode == "fused":  # may have fallen back
                 return
+        if self.loop_mode == "sebulba":
+            self.collector = self._make_sebulba_collector()
+            return
         if getattr(self, "device_collector", False):
             self.collector = self._make_device_collector()
             return
@@ -591,6 +621,67 @@ class RLEpochLoop:
             return
         self.fused = driver
 
+    def _split_sebulba_mesh(self) -> None:
+        """Partition the configured training mesh into the actor
+        sub-mesh and the learner complement (rl/sebulba.py) BEFORE the
+        learner builds: ``self.mesh`` becomes the LEARNER sub-mesh, so
+        the update, checkpoints and eval keep their one mesh handle.
+        An infeasible AUTO split (one device, or lanes that do not
+        divide a sub-mesh) falls back LOUDLY to ``loop_mode=
+        'pipelined'`` with device collection (the fused-fallback
+        convention); an EXPLICIT ``sebulba_config`` that cannot split
+        is a config error and raises."""
+        import warnings
+
+        from ddls_tpu.rl.sebulba import split_meshes
+
+        devs = list(self.mesh.devices.flat)
+        explicit = self.sebulba_config.get("actor_devices")
+        try:
+            actor_mesh, learner_mesh = split_meshes(explicit,
+                                                    devices=devs)
+        except ValueError as err:
+            if explicit is not None:
+                raise
+            warnings.warn(
+                f"sebulba: {err} — falling back to "
+                "loop_mode='pipelined' with device collection")
+            self.loop_mode = "pipelined"
+            return
+        bad = [f"num_envs={self.num_envs} does not divide the {name} "
+               f"sub-mesh dp axis ({int(m.shape['dp'])})"
+               for name, m in (("actor", actor_mesh),
+                               ("learner", learner_mesh))
+               if self.num_envs % int(m.shape["dp"])]
+        if bad:
+            if explicit is not None:
+                raise ValueError("sebulba: " + "; ".join(bad))
+            warnings.warn(
+                "sebulba: " + "; ".join(bad) + " — falling back to "
+                "loop_mode='pipelined' with device collection")
+            self.loop_mode = "pipelined"
+            return
+        self.actor_mesh = actor_mesh
+        self.mesh = learner_mesh
+
+    def _make_sebulba_collector(self):
+        """The actor half of the Sebulba split (rl/sebulba.py): the
+        fused-style in-kernel collection jitted over the actor
+        sub-mesh, handing device trajectories to the learner sub-mesh
+        through a device-mode trajectory ring."""
+        from ddls_tpu.rl.sebulba import SebulbaCollector
+
+        env0, et, ot = self._device_tables()
+        stacked = self._stacked_banks(et, env0, self.num_envs)
+        return SebulbaCollector(
+            et, ot, self.model, stacked, self.rollout_length,
+            actor_mesh=self.actor_mesh,
+            # ring capacity: the depth-K sizing of the shm ring
+            # (depth in-flight batches + the consumed one + slack)
+            ring_segments=int(self.sebulba_config.get("ring_segments")
+                              or self.pipeline_depth + 2),
+            memo_cfg=self._memo_knob())
+
     def _memo_knob(self):
         """The ``use_jax_lookahead_memo`` algo key as the value the
         collectors' ``resolve_memo_cfg`` consumes: "auto" passes
@@ -697,7 +788,8 @@ class RLEpochLoop:
         import jax
 
         self._rng, sub = jax.random.split(self._rng)
-        if self.loop_mode == "pipelined" and jax.process_count() == 1:
+        if (self.loop_mode in ("pipelined", "sebulba")
+                and jax.process_count() == 1):
             # explicit placement beside the replicated params: the jitted
             # update would otherwise reshard the key implicitly onto the
             # mesh every epoch (the transfer-guard pin catches exactly
@@ -869,17 +961,25 @@ class RLEpochLoop:
         no ring is installed. Host ints only — safe to fetch at a
         reporting boundary (the bench JSON line's ``ring`` block)."""
         ring = getattr(self.vec_env, "traj_ring", None)
+        if ring is None:
+            # the sebulba device-mode ring lives on the collector, not
+            # the vec env (rl/sebulba.py)
+            ring = getattr(getattr(self, "collector", None), "ring",
+                           None)
         return ring.stats() if ring is not None else None
 
     # ------------------------------------------------------- fused epoch
     def _maybe_drain_fused_episodes(self, force: bool = False
                                     ) -> List[dict]:
-        """Drain the fused epochs' compact episode-counter traces in ONE
-        batched fetch and harvest episode records, at the SAME sync
-        boundaries as the metrics ring (every ``metrics_sync_interval``
-        epochs, an eval epoch, or ``force``) — never per update. The
-        gate is deterministic (epoch counter + config only — multi-host
-        rules)."""
+        """Drain the fused/sebulba epochs' compact episode-counter
+        traces in ONE batched fetch and harvest episode records, at the
+        SAME sync boundaries as the metrics ring (every
+        ``metrics_sync_interval`` epochs, an eval epoch, or ``force``)
+        — never per update. The gate is deterministic (epoch counter +
+        config only — multi-host rules). The harvester is the owning
+        driver: ``self.fused`` ([U, B, T] traces) or the sebulba
+        collector ([B, T] traces) — both keep host-side episode
+        lengths, so drains must stay in collection order."""
         if not self._fused_episode_ring:
             return []
         is_eval = bool(self.evaluation_interval
@@ -890,12 +990,14 @@ class RLEpochLoop:
             return []
         import jax
 
+        harvester = (self.fused if self.fused is not None
+                     else self.collector)
         ring, self._fused_episode_ring = self._fused_episode_ring, []
         with telemetry.span("train.host_sync"):
             fetched = jax.device_get(ring)
         episodes: List[dict] = []
         for ep in fetched:
-            episodes.extend(self.fused.harvest_episodes(ep))
+            episodes.extend(harvester.harvest_episodes(ep))
         return episodes
 
     def _run_fused(self) -> Dict[str, Any]:
@@ -959,7 +1061,7 @@ class RLEpochLoop:
             # bytes is done — an update output is exactly that marker
             out["ring"].note_update(segment, metrics["total_loss"],
                                     generation=out.get("ring_generation"))
-        if self.loop_mode == "pipelined":
+        if self.loop_mode in ("pipelined", "sebulba"):
             self._watch_update(metrics, update_t0)
 
         self.epoch_counter += 1
@@ -975,13 +1077,20 @@ class RLEpochLoop:
                 ring.observe_params_age(age)
         learner_metrics = self._harvest_metrics(metrics, extras=extras)
         self._maybe_sync_metrics()
+        episodes = out["episodes"]
+        if self.loop_mode == "sebulba":
+            # episode counters stay device-resident until the drain
+            # boundary (fused discipline: the steady-state epoch stays
+            # transfer-free)
+            self._fused_episode_ring.append(out["ep_pending"])
+            episodes = self._maybe_drain_fused_episodes()
         results: Dict[str, Any] = {
             "epoch_counter": self.epoch_counter,
             "env_steps_this_iter": out["env_steps"],
             "total_env_steps": self.total_env_steps,
             "learner": learner_metrics,
         }
-        return self._finalize_results(results, out["episodes"], start)
+        return self._finalize_results(results, episodes, start)
 
     def _finalize_results(self, results: Dict[str, Any],
                           episodes: List[dict], start: float) -> Dict[str, Any]:
@@ -1235,6 +1344,9 @@ class RLEpochLoop:
         if self._chip_lock is not None:
             self._chip_lock.__exit__()
             self._chip_lock = None
+        collector = getattr(self, "collector", None)
+        if collector is not None and hasattr(collector, "close"):
+            collector.close()  # the sebulba device ring's ledger
         self.vec_env.close()
 
 

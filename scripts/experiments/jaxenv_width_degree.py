@@ -60,9 +60,10 @@ def mode_width():
     from ddls_tpu.sim.jax_env import make_episode_fn
 
     env, et, mk_bank = build(8)
-    # memo off: this experiment vmaps the kernel over widths, where the
-    # memo probe's lax.cond lowers to select and would only add dead
-    # overhead to the width scaling being measured (sim/jax_memo.py)
+    # memo off ON PURPOSE: this experiment measures the PLAIN kernel's
+    # width scaling — with the wide probe (sim/jax_memo.py, round 12)
+    # the memo would serve most lookaheads and the curve would measure
+    # cache behaviour instead of the compute being scaled
     episode_fn = make_episode_fn(et, memo_cfg=None)
     rng = np.random.RandomState(0)
     D = 400

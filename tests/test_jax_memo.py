@@ -1,4 +1,4 @@
-"""In-kernel lookahead memo (sim/jax_memo.py, ISSUE 13).
+"""In-kernel lookahead memo (sim/jax_memo.py, ISSUE 13 + 17).
 
 Unit level: a forced hash collision must MISS (bitwise residual compare)
 and recompute — never serve the colliding entry; eviction is
@@ -6,19 +6,22 @@ deterministic round-robin; the canonical grouping matches the host's
 ``np.unique``-based canonicalisation (cluster.py:468-476).
 
 Kernel level: a memo-enabled segment is BITWISE identical to a memo-off
-segment (traces, bootstrap fields) — the hit==recompute contract — the
-table persists across in-kernel episode resets exactly like the host
-``lookahead_cache`` persists across ``reset()`` (misses stop growing
-once the first episode has populated the table), and the hit rate on a
-repeated-placement episode is strictly positive. The x64 leg of the
-hit==recompute contract rides the EXISTING full-episode parity suites
-(test_jax_episode / test_jax_policy_episode run the single-lane episode
-kernels with the memo enabled by default and pin them against the host
+segment (traces, bootstrap fields) — the hit==recompute contract — AT
+EVERY VMAP WIDTH (lanes 1, 2 and 8 — the wide batched probe, ISSUE 17),
+the table persists across in-kernel episode resets exactly like the
+host ``lookahead_cache`` persists across ``reset()`` (misses stop
+growing once the first episode has populated the table), per-lane
+counters drain independently, and the hit rate on a repeated-placement
+episode is strictly positive. The x64 leg of the hit==recompute
+contract rides the EXISTING full-episode parity suites
+(test_jax_episode / test_jax_policy_episode run the episode kernels
+with the memo enabled by default and pin them against the host
 simulator exactly).
 
 Loop level: a lanes=1 fused epoch loop resolves the memo ON by default,
 stays transfer-free in steady state under ``jax.transfer_guard``, and
-reports counters at the drain boundary only.
+reports counters at the drain boundary only; multi-lane collectors
+resolve the memo ON too (resolve_memo_cfg "auto" at every width).
 """
 import os
 import sys
@@ -56,8 +59,11 @@ def _probe(memo, key, value):
 
     import jax.numpy as jnp
 
+    # compute takes the probe's hit flag (the wide-probe mask the real
+    # caller threads into jax_lookahead's while_loop cond); a plain
+    # value ignores it
     (t, ok), memo = memo_lookahead(
-        memo, *key, lambda: (jnp.float32(value), jnp.bool_(True)))
+        memo, *key, lambda skip: (jnp.float32(value), jnp.bool_(True)))
     return float(t), memo
 
 
@@ -164,12 +170,17 @@ def test_resolve_memo_cfg_knob():
     from ddls_tpu.sim.jax_memo import MemoConfig, resolve_memo_cfg
 
     assert resolve_memo_cfg("auto", 1) == MemoConfig()
-    assert resolve_memo_cfg("auto", 8) is None
+    # ISSUE 17: "auto" enables the memo at EVERY lane count — the
+    # batched probe masks hit lanes out of the lookahead while_loop
+    assert resolve_memo_cfg("auto", 8) == MemoConfig()
     assert resolve_memo_cfg(None, 1) is None
+    assert resolve_memo_cfg(None, 8) is None
     cfg = MemoConfig(n_sets=4, n_ways=1)
     assert resolve_memo_cfg(cfg, 8) is cfg
     with pytest.raises(ValueError, match="memo_cfg"):
         resolve_memo_cfg(True, 1)
+    with pytest.raises(ValueError, match="n_lanes"):
+        resolve_memo_cfg("auto", 0)
 
 
 # ========================================================== kernel level
@@ -289,11 +300,98 @@ def test_segment_memo_bitwise_parity_and_cross_reset_persistence(
     assert hit_curve[-1] / (hit_curve[-1] + miss_curve[-1]) > 0.5
 
 
+def _lane_banks(memo_env, n_lanes):
+    """``n_lanes`` DISTINCT job banks (different sla/type streams per
+    lane) stacked on a leading lane axis — distinct lanes make the wide
+    probe's per-lane tables genuinely diverge."""
+    import jax.numpy as jnp
+
+    from ddls_tpu.sim.jax_env import build_job_bank
+
+    et = memo_env["et"]
+    banks = []
+    for lane in range(n_lanes):
+        r = np.random.RandomState(100 + lane)
+        recs = [{"model": et.types[int(r.randint(0, len(et.types)))],
+                 "num_training_steps": 10,
+                 "sla_frac": round(float(r.uniform(0.2, 1.0)), 2),
+                 "time_arrived": 60.0 * i} for i in range(12)]
+        banks.append({k: jnp.asarray(v)
+                      for k, v in build_job_bank(et, recs).items()})
+    return {k: jnp.stack([b[k] for b in banks]) for k in banks[0]}
+
+
+@pytest.mark.parametrize("n_lanes", [2, 8])
+def test_vmapped_segment_memo_bitwise_parity_and_per_lane_drain(
+        memo_env, n_lanes):
+    """The ISSUE 17 load-bearing pin: memo-on == memo-off BITWISE under
+    a multi-lane vmap (the batched probe serves stored bits to hit
+    lanes and masked miss lanes iterate under their own cond), across
+    carried segments spanning in-kernel episode resets; each lane's
+    table persists across ITS resets (per-lane misses freeze once that
+    lane's first episode populated its table), per-lane counters drain
+    independently, and the lane-summed summary matches their total."""
+    import jax
+
+    from ddls_tpu.sim.jax_env import (make_segment_fn, segment_init,
+                                      vmap_segment_fn)
+    from ddls_tpu.sim.jax_memo import MemoConfig, summarize_counters
+
+    et, ot = memo_env["et"], memo_env["ot"]
+    model, params = memo_env["model"], memo_env["params"]
+    banks = _lane_banks(memo_env, n_lanes)
+    mc = MemoConfig(n_sets=16, n_ways=2)
+    seg_on = vmap_segment_fn(
+        make_segment_fn(et, ot, model, 24, memo_cfg=mc), n_lanes)
+    seg_off = vmap_segment_fn(
+        make_segment_fn(et, ot, model, 24), n_lanes)
+    st_on = jax.vmap(lambda b: segment_init(et, b, mc))(banks)
+    st_off = jax.vmap(lambda b: segment_init(et, b))(banks)
+    rng = jax.random.PRNGKey(11)
+    dones = np.zeros(n_lanes, np.int64)
+    miss_curve, hit_curve = [], []
+    for _ in range(3):
+        rng, sub = jax.random.split(rng)
+        lane_rngs = jax.random.split(sub, n_lanes)
+        st_on, tr_on, nf_on = seg_on(banks, params, st_on, lane_rngs)
+        st_off, tr_off, nf_off = seg_off(banks, params, st_off,
+                                         lane_rngs)
+        for k in tr_off:  # identical actions/rewards/counters/fields
+            assert np.array_equal(np.asarray(tr_on[k]),
+                                  np.asarray(tr_off[k])), k
+        for k in nf_off:  # identical bootstrap fields
+            assert np.array_equal(np.asarray(nf_on[k]),
+                                  np.asarray(nf_off[k])), k
+        dones += np.asarray(tr_on["done"]).sum(axis=-1)
+        # per-lane cumulative counters ride the trace: [B, T], last step
+        miss_curve.append(np.asarray(tr_on["memo_misses"])[:, -1])
+        hit_curve.append(np.asarray(tr_on["memo_hits"])[:, -1])
+    assert (dones >= 2).all(), ("every lane must complete episodes for "
+                                f"the cross-reset pin, got {dones}")
+    # cross-reset persistence PER LANE: by the third segment every lane
+    # has completed (and re-entered) episodes, and its replays serve
+    # from the table it populated BEFORE the in-kernel resets — misses
+    # freeze in the steady tail (lanes whose first episode spans the
+    # first segment boundary may add a miss in segment 2, never later)
+    assert np.array_equal(miss_curve[2], miss_curve[1]), miss_curve
+    # every lane hits its own cache (distinct banks, distinct tables)
+    assert (hit_curve[-1] > 0).all(), hit_curve[-1]
+    # distinct banks produce genuinely per-lane counter streams
+    if n_lanes > 1:
+        assert len({int(h) for h in hit_curve[-1]}
+                   | {int(m) for m in miss_curve[-1]}) > 1
+    # the lane-summed reporting summary == sum of per-lane finals
+    summary = summarize_counters(st_on[1])
+    assert summary["hits"] == int(hit_curve[-1].sum())
+    assert summary["misses"] == int(miss_curve[-1].sum())
+    assert 0.0 < summary["hit_rate"] <= 1.0
+
+
 def test_device_collector_resolves_memo_by_lanes_and_reports(memo_env):
     """num_envs=1 -> memo auto-ON with counters at the drain boundary;
-    num_envs>1 -> auto-OFF (vmap select hazard) and counters None."""
+    num_envs>1 -> ALSO auto-ON (the wide batched probe, ISSUE 17) with
+    counters summed over lanes."""
     import jax
-    import jax.numpy as jnp
 
     from ddls_tpu.rl.ppo_device import DevicePPOCollector
 
@@ -313,10 +411,16 @@ def test_device_collector_resolves_memo_by_lanes_and_reports(memo_env):
     # (action-0 decisions skip eval_cfg entirely), never more
     assert 0 < (counters["hits"] + counters["misses"]) <= 48
 
-    two = {k: jnp.stack([v, v]) for k, v in bank.items()}
-    col2 = DevicePPOCollector(et, ot, model, two, rollout_length=4)
-    assert col2.memo_cfg is None
-    assert col2.memo_counters() is None
+    two = _lane_banks(memo_env, 2)
+    col2 = DevicePPOCollector(et, ot, model, two, rollout_length=24)
+    assert col2.memo_cfg is not None, (
+        "auto must resolve the memo ON at every lane count (ISSUE 17)")
+    for seed in (5, 6):
+        col2.collect(params, jax.random.PRNGKey(seed))
+    c2 = col2.memo_counters()
+    assert c2 is not None and c2["hits"] > 0
+    # lane-summed probe count: ≤ one per heavy-path decision per lane
+    assert 0 < (c2["hits"] + c2["misses"]) <= 2 * 48
 
 
 def test_fused_lanes1_memo_on_transfer_free_then_reports(memo_env,

@@ -646,6 +646,11 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
                                "'_assemble_lookahead_key'"),
                  memo_trace_keys=("'memo_hits'",),
                  memo_extra="",
+                 wide_probe=("'jax_lookahead'", "'skip'"),
+                 lookahead_src=("def jax_lookahead(x, *, skip=None):\n"
+                                "    pass\n"),
+                 forward_call=("def run_lookahead(skip=None):\n"
+                               "    jax_lookahead(1, skip=skip)\n"),
                  failure_map=("FAILURE_PREEMPT: 'worker_preempted', "
                               "FAILURE_STRAGGLE: 'channel_degraded'"),
                  flight_kinds=("'worker_preempted'",
@@ -659,7 +664,8 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
         "CAUSE_MOUNTED: 'mounted'}\n"
         + jax_env_extra +
         "def make_segment_fn():\n"
-        "    trace = {'ep_ret': 0, 'action': 1, 'memo_hits': 2}\n")
+        "    trace = {'ep_ret': 0, 'action': 1, 'memo_hits': 2}\n"
+        + forward_call)
     host = ("HOST_CAUSES = (" + ", ".join(host_strings) + ")\n"
             "HOST_EMITS = (" + ", ".join(host_emits) + ",)\n"
             + "".join(f"def {fn}():\n    pass\n" for fn in host_key_fns))
@@ -672,6 +678,7 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
                "    return {'env_index': 0, 'ret': 1.0}\n")
     memo = ("HOST_KEY_SURFACE = (" + ", ".join(memo_surface) + ",)\n"
             "MEMO_TRACE_KEYS = (" + ", ".join(memo_trace_keys) + ",)\n"
+            "WIDE_PROBE_SURFACE = (" + ", ".join(wide_probe) + ",)\n"
             + memo_extra)
     failures = ("FAILURE_PREEMPT = 0\n"
                 "FAILURE_STRAGGLE = 1\n"
@@ -679,12 +686,14 @@ def parity_files(jax_env_extra="", host_strings=("'queue_full'",
     flight = "EVENT_KINDS = (" + ", ".join(flight_kinds) + ",)\n"
     return {"jax_env.py": jax_env, "cluster.py": host, "ppo.py": ppo,
             "rollout.py": rollout, "jax_memo.py": memo,
+            "jax_lookahead.py": lookahead_src,
             "failures.py": failures, "flight.py": flight}
 
 
 PARITY_CFG = {"backend-surface-parity": {
     "jax_env": "jax_env.py", "ppo_device": "ppo.py",
     "rollout": "rollout.py", "jax_memo": "jax_memo.py",
+    "jax_lookahead": "jax_lookahead.py",
     "failures": "failures.py", "flight": "flight.py",
     "host_cause_files": ["cluster.py"],
     "jitted_only_causes": []}}
@@ -798,6 +807,50 @@ def test_backend_parity_memo_surface_moved_fires(tmp_path):
     msgs = [f.message for f in errors_of(res, "backend-surface-parity")]
     assert any("HOST_KEY_SURFACE" in m and "moved" in m for m in msgs)
     assert any("MEMO_TRACE_KEYS" in m and "moved" in m for m in msgs)
+
+
+def test_backend_parity_wide_probe_missing_entry_fn_fires(tmp_path):
+    # the batched probe's masking surface (ISSUE 17): renaming the
+    # lookahead entry point without the memo mirror must fail at lint —
+    # an unmasked probe is correct but inert, so no parity test catches
+    # the drift
+    files = parity_files(
+        lookahead_src="def jax_lookahead_v2(x, *, skip=None):\n    pass\n")
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("'jax_lookahead'" in f.message
+               and "entry point moved" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_wide_probe_missing_keyword_fires(tmp_path):
+    files = parity_files(
+        lookahead_src="def jax_lookahead(x):\n    pass\n")
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("'skip'" in f.message and "nothing to bind" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_wide_probe_not_forwarded_fires(tmp_path):
+    files = parity_files(
+        forward_call="def run_lookahead():\n    jax_lookahead(1)\n")
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("forwards skip=" in f.message and "inert" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
+
+
+def test_backend_parity_wide_probe_surface_moved_fires(tmp_path):
+    files = parity_files()
+    files["jax_memo.py"] = (
+        "HOST_KEY_SURFACE = ('lookahead_key_for', "
+        "'_assemble_lookahead_key',)\n"
+        "MEMO_TRACE_KEYS = ('memo_hits',)\n")
+    res = lint_tree(tmp_path, files, "backend-surface-parity",
+                    PARITY_CFG)
+    assert any("WIDE_PROBE_SURFACE" in f.message and "moved" in f.message
+               for f in errors_of(res, "backend-surface-parity"))
 
 
 def test_backend_parity_failure_map_nonbijective_fires(tmp_path):
