@@ -125,9 +125,20 @@ def compiled_cost_analysis(jitted, *args, n_dev: int,
     return flops * n_dev if flops is not None else None
 
 
+# opt-in run ledger (telemetry/runlog.py, ISSUE 18): set by main() when
+# --run-dir is given; emit() mirrors every payload into result.json so
+# the run directory is self-contained even on error/timeout emit paths
+_RUN_LEDGER = None
+
+
 def emit(payload: dict) -> None:
     """The driver parses exactly one JSON line from stdout."""
     print(json.dumps(payload), flush=True)
+    if _RUN_LEDGER is not None:
+        try:
+            _RUN_LEDGER.record_result(payload)
+        except Exception:
+            pass  # the ledger must never break the JSON line contract
     # mirror the final registry state to the JSONL sink (no-op without
     # one) so --telemetry-jsonl files are self-contained even on the
     # error/timeout emit paths; serve mode's private server registry
@@ -2169,6 +2180,15 @@ def main(argv=None) -> int:
                         help="append span/event/snapshot records to this "
                              "JSONL sink (see scripts/telemetry_report.py;"
                              " env fallback: DDLS_TELEMETRY_JSONL)")
+    parser.add_argument("--run-dir", default=None,
+                        help="write a fingerprinted RunLedger directory "
+                             "(manifest.json + telemetry.jsonl + "
+                             "result.json + snapshot.json — "
+                             "telemetry/runlog.py); merge into a "
+                             "Perfetto trace with `python -m "
+                             "ddls_tpu.telemetry.timeline <dir>`. "
+                             "Overrides --telemetry-jsonl for the run's "
+                             "sink")
     args = parser.parse_args(argv)
     # fresh telemetry window per invocation (tests drive main() several
     # times in one process; each bench line must snapshot ITS run only),
@@ -2183,9 +2203,25 @@ def main(argv=None) -> int:
     telemetry.reset()
     telemetry.enable(sink_path=(args.telemetry_jsonl
                                 or telemetry.env_sink_path()))
+    global _RUN_LEDGER
+    if args.run_dir:
+        from ddls_tpu.telemetry.runlog import RunLedger
+
+        # opened inside bench's telemetry window: the ledger swaps the
+        # sink to <run_dir>/telemetry.jsonl and finalize() hands the
+        # prior sink back before the window's own restore below
+        _RUN_LEDGER = RunLedger(
+            args.run_dir, kind=f"bench:{args.mode}",
+            config={k: v for k, v in vars(args).items()},
+            probe_dir=PROBE_DIR).open()
     try:
         return _dispatch_mode(args, process_start)
     finally:
+        if _RUN_LEDGER is not None:
+            try:
+                _RUN_LEDGER.finalize()
+            finally:
+                _RUN_LEDGER = None
         if reg.sink is not prev_sink and reg.sink is not None:
             reg.sink.close()
         reg.sink = prev_sink

@@ -199,6 +199,12 @@ class Rule:
     pointer: str = ""
     #: None = every scanned file; otherwise repo-relative dir prefixes
     scope_dirs: Optional[Tuple[str, ...]] = None
+    #: repo-relative dirs OUTSIDE the default roots that this rule (and
+    #: only this rule) also scans on a default run — the engine parses
+    #: them once and gates every other rule off those files; explicit
+    #: ``--paths`` runs ignore this (fixture trees keep all-rules
+    #: behavior)
+    extra_roots: Tuple[str, ...] = ()
 
     def in_scope(self, rel: str) -> bool:
         if self.scope_dirs is None:

@@ -53,16 +53,32 @@ def run_lint(roots: Optional[Sequence[str]] = None,
     if repo_root is None:
         repo_root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
-    if roots is None:
-        roots = [os.path.join(repo_root, "ddls_tpu")]
     if config is None:
         config = load_config(repo_root)
     if rules is None:
         rules = ALL_RULES
 
+    # per-rule extra roots ride the DEFAULT run only: an explicit
+    # --paths invocation (fixture trees, the legacy single-rule shims)
+    # keeps the current all-rules-over-given-roots behavior
+    restricted: Dict[str, set] = {}
+    extra_scan: List[tuple] = []
+    if roots is None:
+        roots = [os.path.join(repo_root, "ddls_tpu")]
+        for rule in rules:
+            for d in rule.extra_roots:
+                extra_scan.append((rule.id, os.path.join(repo_root, d)))
+
     ctx = Context(repo_root=repo_root, config=config)
     for sf in discover(roots, repo_root):
         ctx.files[sf.rel] = sf
+    for rule_id, extra_root in extra_scan:
+        for sf in discover([extra_root], repo_root):
+            if sf.rel not in ctx.files:
+                ctx.files[sf.rel] = sf
+                restricted[sf.rel] = set()
+            if sf.rel in restricted:
+                restricted[sf.rel].add(rule_id)
 
     active_ids = {rule.id for rule in rules}
     # a suppression naming an id outside the registry suppresses
@@ -99,7 +115,10 @@ def run_lint(roots: Optional[Sequence[str]] = None,
                 continue
             findings.append(Finding("lint-suppression", sf.rel, lineno,
                                     message))
+        allowed_rules = restricted.get(sf.rel)
         for rule in rules:
+            if allowed_rules is not None and rule.id not in allowed_rules:
+                continue  # file came in via another rule's extra_roots
             if rule.in_scope(sf.rel):
                 findings.extend(rule.check_file(sf, ctx))
     for rule in rules:

@@ -27,6 +27,10 @@ class BareTimersRule(Rule):
                "goes in [tool.ddls_lint.bare-timers.allow] in "
                "pyproject.toml with a why-comment")
     scope_dirs = None  # the whole package
+    # timing evidence in tooling matters as much as in the package: new
+    # scripts/ timers must ride telemetry.span too (ISSUE 18); only this
+    # rule sees the scripts tree on a default run
+    extra_roots = ("scripts",)
 
     def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
         occ_lines = [i for i, line in enumerate(sf.lines, start=1)

@@ -232,6 +232,9 @@ class TrajRing:
         self.releases += 1
         if telemetry.enabled():
             telemetry.inc("rollout.ring.release")
+            telemetry.record_event("ring_segment", phase="release",
+                                   segment=seg.index,
+                                   generation=seg.generation)
         self._cond.notify_all()
 
     def _next_free_locked(self) -> Optional[RingSegment]:
@@ -263,6 +266,9 @@ class TrajRing:
                 self.stalls += 1
                 if telemetry.enabled():
                     telemetry.inc("rollout.ring.stall")
+                    telemetry.record_event("ring_segment", phase="stall",
+                                           segment=None,
+                                           occupied=occupied)
             while seg is None:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -290,6 +296,9 @@ class TrajRing:
             self.leases += 1
             if telemetry.enabled():
                 telemetry.inc("rollout.ring.lease")
+                telemetry.record_event("ring_segment", phase="lease",
+                                       segment=seg.index,
+                                       generation=seg.generation)
             return seg
 
     def publish(self, seg: RingSegment) -> None:
@@ -304,6 +313,9 @@ class TrajRing:
             self.publishes += 1
             if telemetry.enabled():
                 telemetry.inc("rollout.ring.publish")
+                telemetry.record_event("ring_segment", phase="publish",
+                                       segment=seg.index,
+                                       generation=seg.generation)
             self._cond.notify_all()
 
     def set_release_token(self, seg: RingSegment, token: Any,
@@ -377,6 +389,7 @@ class TrajRing:
         if telemetry.enabled():
             telemetry.observe("rollout.ring.params_age_updates", int(age),
                               buckets=OCCUPANCY_BUCKETS)
+            telemetry.record_event("params_age", value=int(age))
 
     def stats(self) -> Dict[str, Any]:
         """Ledger counters as one host-side dict (no device fetch):

@@ -46,6 +46,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ddls_tpu import telemetry
 from ddls_tpu.rl.fused import EPISODE_TRACE_KEYS
 from ddls_tpu.rl.ring import TrajRing
 
@@ -180,9 +181,16 @@ class SebulbaCollector:
         import jax
 
         seg = self.ring.lease()
-        params = jax.device_put(params, self._repl)
-        lane_rngs = jax.device_put(
-            jax.random.split(rng, self.num_envs), self._lane)
+        # transfer-ledger wraps (gated; NULL_SPAN + no-op add when
+        # telemetry is off) around the EXISTING explicit hops — byte
+        # attribution is .nbytes metadata only, transfer-guard safe
+        with telemetry.transfer("sebulba.params", "l2a") as tr:
+            params = jax.device_put(params, self._repl)
+            tr.add(params)
+        with telemetry.transfer("sebulba.rngs", "h2d") as tr:
+            lane_rngs = jax.device_put(
+                jax.random.split(rng, self.num_envs), self._lane)
+            tr.add(lane_rngs)
         self._state, traj, last_values, ep = self._actor(
             self.banks, params, self._state, lane_rngs)
         self.ring.publish(seg)

@@ -40,19 +40,21 @@ from typing import Any, Dict, Optional, Sequence
 from ddls_tpu.telemetry.metrics import (DEFAULT_LATENCY_BUCKETS_S,
                                         DEFAULT_WINDOW, NULL_SPAN, Counter,
                                         Gauge, Histogram, NullSpan,
-                                        Registry, Span, aggregate_snapshots,
+                                        Registry, Span, TransferSpan,
+                                        aggregate_snapshots,
                                         overlap_summary,
-                                        percentile_from_bucket_counts)
+                                        percentile_from_bucket_counts,
+                                        tree_nbytes)
 from ddls_tpu.telemetry.sink import JsonlSink
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Span", "NullSpan",
-    "NULL_SPAN", "JsonlSink", "DEFAULT_LATENCY_BUCKETS_S",
+    "NULL_SPAN", "TransferSpan", "JsonlSink", "DEFAULT_LATENCY_BUCKETS_S",
     "DEFAULT_WINDOW", "percentile_from_bucket_counts", "overlap_summary",
-    "aggregate_snapshots",
-    "registry", "enabled", "enable", "disable", "span", "inc", "observe",
-    "set_gauge", "record_event", "snapshot", "span_summaries", "reset",
-    "dump_snapshot", "clock_now", "record_span", "span_intervals",
+    "aggregate_snapshots", "tree_nbytes",
+    "registry", "enabled", "enable", "disable", "span", "transfer", "inc",
+    "observe", "set_gauge", "record_event", "snapshot", "span_summaries",
+    "reset", "dump_snapshot", "clock_now", "record_span", "span_intervals",
 ]
 
 _GLOBAL = Registry(enabled=False)
@@ -116,6 +118,18 @@ def span(name: str):
     if not _GLOBAL.enabled:
         return NULL_SPAN
     return Span(_GLOBAL, name)
+
+
+def transfer(name: str, direction: str):
+    """A timed, byte-attributed block around an EXISTING explicit
+    device_put/device_get/drain site (the transfer ledger, ISSUE 18):
+    ``with telemetry.transfer("sebulba.params", "h2d") as tr: ...;
+    tr.add(tree)``. The shared no-op singleton when disabled — zero
+    allocation, and ``add`` never reads device data either way
+    (``.nbytes`` metadata only), so transfer-guard pins stay valid."""
+    if not _GLOBAL.enabled:
+        return NULL_SPAN
+    return TransferSpan(_GLOBAL, name, direction)
 
 
 def inc(name: str, n: int = 1) -> None:

@@ -271,6 +271,7 @@ class RLEpochLoop:
                  fused_config: Optional[dict] = None,
                  sebulba_config: Optional[dict] = None,
                  path_to_model_cls: Optional[str] = None,  # config parity
+                 run_ledger=None,
                  **kwargs):
         import jax
 
@@ -472,6 +473,27 @@ class RLEpochLoop:
         self.best_checkpoint_path: Optional[str] = None
         self.checkpoint_history: List[dict] = []
         self.run_time = 0.0
+
+        # opt-in run ledger (telemetry/runlog.py, ISSUE 18): the
+        # manifest records the RESOLVED loop config; close() finalizes
+        # it with the ring/memo counter blocks and final results
+        self.run_ledger = run_ledger
+        if self.run_ledger is not None:
+            self.run_ledger.update_config({
+                "algo": next((k for k, v in EPOCH_LOOPS.items()
+                              if v is type(self)), type(self).__name__),
+                "loop_mode": self.loop_mode,
+                "num_envs": self.num_envs,
+                "rollout_length": self.rollout_length,
+                "updates_per_epoch": self.updates_per_epoch,
+                "pipeline_depth": self.pipeline_depth,
+                "metrics_sync_interval": self.metrics_sync_interval,
+                "device_collector": self.device_collector,
+                "vec_env_backend": self.vec_env_backend,
+                "n_devices": getattr(self.mesh, "size", None),
+                "seed": self.seed,
+            })
+            self.run_ledger.open()
 
     # ------------------------------------------------------------ algo hooks
     def _size_rollouts(self, algo_config, num_envs, rollout_length,
@@ -828,9 +850,16 @@ class RLEpochLoop:
         segments wait for an update-output token attached in ``run``."""
         with telemetry.span("train.collect"):
             out = self.collector.collect(params, rng)
+        # the staging hop is also a transfer-ledger record (ISSUE 18):
+        # host→device for host collection, actor→learner mesh for
+        # sebulba — bytes from .nbytes metadata only
+        direction = "a2l" if self.loop_mode == "sebulba" else "h2d"
         with telemetry.span("train.device_transfer"):
-            straj, slv = self.learner.shard_traj(out["traj"],
-                                                 out["last_values"])
+            with telemetry.transfer("stage.traj", direction) as tr:
+                straj, slv = self.learner.shard_traj(out["traj"],
+                                                     out["last_values"])
+                tr.add(straj)
+                tr.add(slv)
         segment = out.get("ring_segment")
         if segment is not None:
             # phase 1 of the ring token protocol (rl/ring.py
@@ -948,7 +977,32 @@ class RLEpochLoop:
 
         ring, self._metrics_ring = self._metrics_ring, []
         with telemetry.span("train.host_sync"):
-            LazyMetrics.materialize_group(ring)
+            with telemetry.transfer("drain.metrics", "d2h") as tr:
+                if telemetry.enabled():
+                    for lm in ring:
+                        tr.add(lm.device_values())
+                LazyMetrics.materialize_group(ring)
+        self._record_memo_drain()
+
+    def _record_memo_drain(self) -> None:
+        """Telemetry-only memo-counter event at a sync boundary (the
+        timeline's memo hit-rate counter track): a drain is already a
+        sanctioned device-fetch boundary, and the fetch only happens
+        while telemetry is enabled (local arrays — no collective, so a
+        per-process telemetry toggle stays multi-host safe)."""
+        if not telemetry.enabled():
+            return
+        source = self.fused if self.fused is not None else getattr(
+            self, "collector", None)
+        fn = getattr(source, "memo_counters", None)
+        if fn is None:
+            return
+        try:
+            counters = fn()
+        except Exception:
+            return
+        if counters:
+            telemetry.record_event("memo_counters", **counters)
 
     def sync_metrics(self) -> None:
         """Force-drain any unsynced metrics (checkpoint/shutdown/test
@@ -994,7 +1048,9 @@ class RLEpochLoop:
                      else self.collector)
         ring, self._fused_episode_ring = self._fused_episode_ring, []
         with telemetry.span("train.host_sync"):
-            fetched = jax.device_get(ring)
+            with telemetry.transfer("drain.episodes", "d2h") as tr:
+                tr.add(ring)
+                fetched = jax.device_get(ring)
         episodes: List[dict] = []
         for ep in fetched:
             episodes.extend(harvester.harvest_episodes(ep))
@@ -1341,6 +1397,28 @@ class RLEpochLoop:
         # ``undrained_episodes`` for callers that aggregate records
         self.undrained_episodes = self._maybe_drain_fused_episodes(
             force=True)
+        if self.run_ledger is not None:
+            # run-boundary counter blocks for snapshot.json (host ints /
+            # already-fetched values only — one memo fetch, no per-epoch
+            # cost)
+            source = (self.fused if self.fused is not None
+                      else getattr(self, "collector", None))
+            memo_fn = getattr(source, "memo_counters", None)
+            memo = None
+            if memo_fn is not None:
+                try:
+                    memo = memo_fn()
+                except Exception:
+                    memo = None
+            if memo and telemetry.enabled():
+                telemetry.record_event("memo_counters", **memo)
+            self.run_ledger.finalize(blocks={
+                "ring": self.ring_stats(),
+                "memo": memo,
+                "train": {"epochs": self.epoch_counter,
+                          "total_env_steps": self.total_env_steps,
+                          "run_time_s": self.run_time},
+            })
         if self._chip_lock is not None:
             self._chip_lock.__exit__()
             self._chip_lock = None

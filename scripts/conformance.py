@@ -53,7 +53,22 @@ def main(argv=None) -> int:
                         help="emit one machine-readable JSON document")
     parser.add_argument("--strict", action="store_true",
                         help="treat skipped/unavailable legs as failures")
+    parser.add_argument("--run-dir", default=None,
+                        help="write a RunLedger directory (manifest + "
+                             "telemetry sink + the report doc as "
+                             "result.json — telemetry/runlog.py)")
     args = parser.parse_args(argv)
+
+    ledger = None
+    if args.run_dir:
+        from ddls_tpu.telemetry.runlog import RunLedger
+
+        ledger = RunLedger(args.run_dir, kind="conformance",
+                           config={"spec": args.spec, "legs": args.legs,
+                                   "seed": args.seed,
+                                   "max_decisions": args.max_decisions,
+                                   "sim_seconds": args.sim_seconds,
+                                   "strict": args.strict}).open()
 
     names = args.spec if args.spec else sorted(REGISTRY)
     reports = []
@@ -62,15 +77,25 @@ def main(argv=None) -> int:
             spec = get_spec(name)
         except Exception as exc:
             print(f"error: {exc}", file=sys.stderr)
+            if ledger is not None:
+                ledger.finalize()
             return 2
         reports.append(run_conformance(
             spec, seed=args.seed, max_decisions=args.max_decisions,
             sim_seconds=args.sim_seconds, legs=args.legs))
+    if ledger is not None and reports:
+        # one conformance run may span several specs: record every
+        # fingerprint in the manifest config (rewritten in place)
+        ledger.update_config({"scenario_fingerprints": [
+            r["spec"].get("fingerprint") for r in reports]})
 
     passing = ("ok",) if args.strict else ("ok", "skipped", "unavailable")
     ok = all(leg["status"] in passing
              for r in reports for leg in r["legs"])
     doc = {"ok": ok, "specs": reports}
+    if ledger is not None:
+        ledger.record_result(doc)
+        ledger.finalize()
     if args.json:
         print(json.dumps(doc, indent=2, default=str))
     else:

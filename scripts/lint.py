@@ -14,8 +14,10 @@ id, file, line, message, suppression state) for bench/report tooling;
 (the self-tests use synthetic trees).
 
 Allowlists live in ``[tool.ddls_lint]`` in pyproject.toml; inline
-suppressions are ``# ddls-lint: allow(rule-id) -- <why>`` (the reason is
-mandatory). The legacy ``check_no_bare_timers.py`` /
+suppressions use the ``ddls-lint: allow(rule-id) -- <why>`` comment
+syntax (the reason is mandatory — the example here omits the leading
+hash so the engine's own scan of scripts/ does not parse it as a real
+suppression). The legacy ``check_no_bare_timers.py`` /
 ``check_flight_gated.py`` / ``check_shm_unlink.py`` scripts are thin
 shims over single rules of this engine.
 """

@@ -1,0 +1,298 @@
+"""Bench-trajectory history table + regression gate (ISSUE 18).
+
+Parses the committed ``BENCH_r0*.json`` artifacts (both shapes: the
+round-1..5 single-payload ``{n, cmd, rc, tail, parsed}`` wrapper and the
+round-6+ ``{round, what, runs: [{label, cmd, payload}]}`` document) and
+any RunLedger directories (telemetry/runlog.py ``result.json``) into one
+machine-readable history of headline metrics — so the perf story that
+today lives across eight artifacts and CHANGES.md prose is a table.
+
+Usage::
+
+    python scripts/perf_history.py                 # human table
+    python scripts/perf_history.py --json          # machine-readable
+    python scripts/perf_history.py --check --json  # structural gate
+                                                   # (the tier-1 smoke)
+    python scripts/perf_history.py --check --fresh line.json \
+        --metric ppo_env_steps_per_sec --tolerance 0.3
+
+``--check`` alone is the structural gate: every artifact parses, rounds
+are monotonically increasing, and the table is non-empty (exit 1
+otherwise) — no bench execution, so it rides tier-1. With ``--fresh``
+(a file holding one bench JSON line/payload, or a RunLedger directory)
+it becomes the regression gate: the fresh value of ``--metric`` must
+not fall more than ``--tolerance`` (fractional) below the most recent
+matching history row.
+
+Timing discipline: this script does no timing of its own — any future
+timing must ride ``telemetry.span`` (the lint engine's bare-timers rule
+covers ``scripts/`` too).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ROUND_RE = re.compile(r"BENCH_r0*(\d+)\.json$")
+
+
+def _row(artifact: str, round_no: Optional[int], label: Optional[str],
+         metric: str, value, unit: Optional[str] = None,
+         platform: Optional[str] = None,
+         vs_baseline=None) -> Dict[str, Any]:
+    return {"artifact": artifact, "round": round_no, "label": label,
+            "metric": metric, "value": value, "unit": unit,
+            "platform": platform, "vs_baseline": vs_baseline}
+
+
+def rows_from_payload(artifact: str, round_no: Optional[int],
+                      label: Optional[str],
+                      payload: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten one bench payload into history rows: the headline
+    metric/value pair when present, plus the well-known nested blocks
+    (loop_modes throughputs, A/B sub-results, memo speedups) the later
+    rounds report instead of a single number."""
+    rows: List[Dict[str, Any]] = []
+    if not isinstance(payload, dict):
+        return rows
+    platform = payload.get("platform")
+    if payload.get("metric") and payload.get("value") is not None:
+        rows.append(_row(artifact, round_no, label, payload["metric"],
+                         payload["value"], payload.get("unit"),
+                         platform, payload.get("vs_baseline")))
+    loop_modes = payload.get("loop_modes")
+    if isinstance(loop_modes, dict):
+        for mode, st in sorted(loop_modes.items()):
+            v = st.get("env_steps_per_sec") if isinstance(st, dict) else None
+            if v is not None:
+                rows.append(_row(artifact, round_no, label,
+                                 f"loop_modes.{mode}.env_steps_per_sec",
+                                 v, "env_steps/s", platform))
+    # A/B payloads (sebulba_ab, impala depth A/B, fused solo) carry
+    # per-arm dicts instead of a headline metric
+    for key, st in payload.items():
+        if isinstance(st, dict) and "env_steps_per_sec" in st:
+            rows.append(_row(artifact, round_no, label,
+                             f"{key}.env_steps_per_sec",
+                             st["env_steps_per_sec"], "env_steps/s",
+                             platform))
+        if isinstance(st, dict) and "aggregate_dec_per_s" in st:
+            rows.append(_row(artifact, round_no, label,
+                             f"{key}.aggregate_dec_per_s",
+                             st["aggregate_dec_per_s"], "decisions/s",
+                             platform))
+    if isinstance(payload.get("speedup"), (int, float)):
+        rows.append(_row(artifact, round_no, label, "speedup",
+                         payload["speedup"], "x", platform))
+    return rows
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    """One BENCH artifact → {"round", "rows", "error"}; a file that
+    fails to parse is an error entry, not an exception (the gate counts
+    them)."""
+    name = os.path.basename(path)
+    m = _ROUND_RE.search(name)
+    round_no = int(m.group(1)) if m else None
+    out: Dict[str, Any] = {"artifact": name, "round": round_no,
+                           "rows": [], "error": None}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except Exception as exc:
+        out["error"] = f"unparseable: {exc}"
+        return out
+    if not isinstance(doc, dict):
+        out["error"] = f"unexpected top-level {type(doc).__name__}"
+        return out
+    if "runs" in doc:  # round-6+ multi-run document
+        if doc.get("round") is not None:
+            out["round"] = doc["round"]
+        for run in doc.get("runs", []):
+            out["rows"].extend(rows_from_payload(
+                name, out["round"], run.get("label"),
+                run.get("payload") or {}))
+    elif "parsed" in doc:  # round-1..5 single-payload wrapper
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            out["rows"].extend(rows_from_payload(
+                name, round_no, None, parsed))
+        elif doc.get("rc", 0) != 0:
+            # a recorded failure (round 1's seed crash) is part of the
+            # history, not a parse error
+            out["rows"].append(_row(name, round_no, None, "bench_failed",
+                                    None))
+    else:
+        out["error"] = "unknown artifact shape"
+    return out
+
+
+def load_run_ledger(run_dir: str) -> Dict[str, Any]:
+    """A RunLedger directory (result.json payloads) as history rows."""
+    from ddls_tpu.telemetry.runlog import load_run_dir
+
+    run = load_run_dir(run_dir)
+    name = os.path.basename(os.path.normpath(run_dir))
+    kind = (run.get("manifest") or {}).get("kind")
+    rows: List[Dict[str, Any]] = []
+    for payload in run.get("results", []):
+        rows.extend(rows_from_payload(name, None, kind, payload))
+    return {"artifact": name, "round": None, "rows": rows,
+            "error": None if "manifest" in run else "no manifest.json"}
+
+
+def collect_history(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    entries = []
+    for path in paths:
+        if os.path.isdir(path):
+            entries.append(load_run_ledger(path))
+        else:
+            entries.append(load_artifact(path))
+    return entries
+
+
+def structural_check(entries: Sequence[Dict[str, Any]]) -> List[str]:
+    """The --check gate's structural half: parse failures, an empty
+    table, or non-increasing rounds across BENCH artifacts."""
+    problems = [f"{e['artifact']}: {e['error']}"
+                for e in entries if e["error"]]
+    if not any(e["rows"] for e in entries):
+        problems.append("no history rows parsed from any artifact")
+    rounds = [e["round"] for e in entries if e["round"] is not None]
+    if rounds != sorted(rounds):
+        problems.append(f"artifact rounds out of order: {rounds}")
+    return problems
+
+
+def latest_value(entries: Sequence[Dict[str, Any]],
+                 metric: str) -> Optional[Dict[str, Any]]:
+    """Most recent row (highest round, then file order) whose metric
+    matches exactly or by headline name."""
+    best = None
+    for e in entries:
+        for row in e["rows"]:
+            if row["metric"] == metric and row["value"] is not None:
+                best = row  # entries arrive in round order
+    return best
+
+
+def regression_check(entries: Sequence[Dict[str, Any]], fresh_path: str,
+                     metric: str, tolerance: float) -> Dict[str, Any]:
+    """The --fresh half of --check: compare a fresh bench line (file of
+    one JSON payload, or a RunLedger dir) against the last matching
+    history row, within a fractional tolerance band."""
+    if os.path.isdir(fresh_path):
+        fresh_rows = load_run_ledger(fresh_path)["rows"]
+    else:
+        with open(fresh_path) as f:
+            text = f.read().strip()
+        payload = json.loads(text.splitlines()[-1]) if text else {}
+        fresh_rows = rows_from_payload(os.path.basename(fresh_path),
+                                       None, "fresh", payload)
+    fresh = next((r for r in fresh_rows
+                  if r["metric"] == metric and r["value"] is not None),
+                 None)
+    baseline = latest_value(entries, metric)
+    verdict: Dict[str, Any] = {"metric": metric, "tolerance": tolerance,
+                               "fresh": fresh, "baseline": baseline}
+    if fresh is None:
+        verdict["ok"] = False
+        verdict["reason"] = (f"fresh input has no value for metric "
+                             f"{metric!r}")
+        return verdict
+    if baseline is None:
+        verdict["ok"] = True
+        verdict["reason"] = (f"no history row for {metric!r} — "
+                             "recording, not comparing")
+        return verdict
+    floor = baseline["value"] * (1.0 - tolerance)
+    verdict["floor"] = floor
+    verdict["ok"] = fresh["value"] >= floor
+    if not verdict["ok"]:
+        verdict["reason"] = (
+            f"{metric} regressed: fresh {fresh['value']} < floor "
+            f"{floor:.4g} (last {baseline['value']} in "
+            f"{baseline['artifact']}, tolerance {tolerance:.0%})")
+    return verdict
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="bench history table + regression gate over "
+                    "BENCH_r0*.json and RunLedger directories")
+    parser.add_argument("paths", nargs="*",
+                        help="artifacts / run dirs (default: the repo's "
+                             "BENCH_r*.json, in round order)")
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--check", action="store_true",
+                        help="gate mode: structural check of the "
+                             "committed artifacts; with --fresh, a "
+                             "regression comparison")
+    parser.add_argument("--fresh", default=None,
+                        help="a fresh bench JSON line file or RunLedger "
+                             "dir to compare against history")
+    parser.add_argument("--metric", default="ppo_env_steps_per_sec",
+                        help="metric name for the --fresh comparison")
+    parser.add_argument("--tolerance", type=float, default=0.3,
+                        help="allowed fractional drop vs the last "
+                             "history value (default 0.3)")
+    args = parser.parse_args(argv)
+
+    paths = args.paths or sorted(
+        glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+        key=lambda p: (_ROUND_RE.search(p) and
+                       int(_ROUND_RE.search(p).group(1))) or 0)
+    if not paths:
+        print("no BENCH artifacts found", file=sys.stderr)
+        return 2
+    entries = collect_history(paths)
+    doc: Dict[str, Any] = {
+        "artifacts": [{"artifact": e["artifact"], "round": e["round"],
+                       "rows": len(e["rows"]), "error": e["error"]}
+                      for e in entries],
+        "rows": [r for e in entries for r in e["rows"]],
+    }
+    ok = True
+    if args.check:
+        problems = structural_check(entries)
+        doc["structural_problems"] = problems
+        ok = not problems
+        if args.fresh:
+            verdict = regression_check(entries, args.fresh, args.metric,
+                                       args.tolerance)
+            doc["regression"] = verdict
+            ok = ok and verdict["ok"]
+        doc["ok"] = ok
+
+    if args.json:
+        print(json.dumps(doc, indent=2, default=str))
+    else:
+        width = max((len(r["metric"]) for r in doc["rows"]), default=10)
+        for r in doc["rows"]:
+            rnd = f"r{r['round']:02d}" if r["round"] is not None else "  -"
+            label = f" [{r['label']}]" if r["label"] else ""
+            val = (f"{r['value']:.4g}"
+                   if isinstance(r["value"], (int, float)) else "-")
+            unit = r["unit"] or ""
+            print(f"{rnd}  {r['metric']:<{width}} {val:>10} {unit:<12}"
+                  f"{r['platform'] or '':<8}{label}")
+        if args.check:
+            for p in doc.get("structural_problems", []):
+                print(f"PROBLEM: {p}")
+            if "regression" in doc and not doc["regression"]["ok"]:
+                print(f"REGRESSION: {doc['regression'].get('reason')}")
+            print("PERF_HISTORY " + ("OK" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

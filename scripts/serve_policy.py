@@ -248,6 +248,10 @@ def main(argv=None) -> int:
                              " to this JSONL sink (summarize with "
                              "scripts/telemetry_report.py; env fallback: "
                              "DDLS_TELEMETRY_JSONL)")
+    parser.add_argument("--run-dir", default=None,
+                        help="write a RunLedger directory (manifest + "
+                             "telemetry sink + fleet snapshot — "
+                             "telemetry/runlog.py)")
     args = parser.parse_args(argv)
 
     if args.selftest:
@@ -266,6 +270,17 @@ def main(argv=None) -> int:
     sink_path = args.telemetry_jsonl or telemetry.env_sink_path()
     if args.stats_interval or sink_path:
         telemetry.enable(sink_path=sink_path)
+    ledger = None
+    if args.run_dir:
+        from ddls_tpu.telemetry.runlog import RunLedger
+
+        # the ledger's sink takes over for the run window (its open
+        # enables telemetry); the fleet rollup lands as a snapshot block
+        # in finalize() below
+        ledger = RunLedger(args.run_dir, kind="serve",
+                           config={"config_name": args.config_name,
+                                   "checkpoint": args.checkpoint,
+                                   "replicas": args.replicas}).open()
 
     # production path: bounded backend probe BEFORE the first in-process
     # jax import — a wedged axon tunnel must cost one timeout at startup,
@@ -426,6 +441,9 @@ def main(argv=None) -> int:
         # counters/histograms from)
         telemetry.dump_snapshot(
             extra={"serve": server.registry_snapshots()})
+    if ledger is not None:
+        ledger.record_result({"serve_stats": server.summary()})
+        ledger.finalize(blocks={"serve": server.registry_snapshots()})
     return 0
 
 

@@ -18,7 +18,17 @@ Reads the three record types ``ddls_tpu.telemetry`` writes
 * ``flight`` records (episode flight-recorder traces,
   ``ddls_tpu.telemetry.flight`` — also the whole-file format
   ``flight.save_jsonl`` writes) get a trace summary: events by kind,
-  blocks by cause, and a per-job lifecycle table.
+  blocks by cause, and a per-job lifecycle table;
+* ``transfer`` records (the gated transfer ledger,
+  ``telemetry.transfer(...)``) get a per-hop table (count / bytes /
+  duration / effective bandwidth) plus a sebulba cross-mesh section
+  when the run carried ``l2a``/``a2l`` hops (docs/telemetry.md "Run
+  ledger & unified timeline").
+
+``--timeline RUN_DIR [RUN_DIR ...]`` delegates to
+``ddls_tpu.telemetry.timeline`` instead: merge RunLedger directories
+into one Perfetto trace (``-o`` names the output, default
+timeline.json).
 
 Exit codes: 0 on success (even for an empty file — it says so), 2 when
 the file is missing/unreadable.
@@ -205,6 +215,74 @@ def _flight_section(flight_events: List[dict]) -> List[str]:
     return lines + [""]
 
 
+def _transfer_section(transfers: List[dict]) -> List[str]:
+    """Transfer-ledger rollup (``telemetry.transfer``): one row per hop
+    name with count / total bytes / duration percentiles / effective
+    bandwidth, so the ~116 ms tunnel RTT amortisation is readable from
+    any run's JSONL (bytes ride record metadata — no device sync was
+    paid to collect them)."""
+    by_name: Dict[str, List[dict]] = defaultdict(list)
+    for rec in transfers:
+        by_name[rec.get("name", "?")].append(rec)
+    lines = ["== transfers (gated ledger; bytes from aval metadata) ==",
+             f"{'hop':<24}{'dir':<6}{'count':>7}{'total_MB':>10}"
+             f"{'mean_ms':>10}{'p95_ms':>10}{'MB/s':>10}"]
+    for name in sorted(by_name):
+        recs = by_name[name]
+        durs = np.asarray([float(r.get("dur_s", 0.0)) for r in recs])
+        total_b = sum(int(r.get("bytes", 0)) for r in recs)
+        total_s = float(durs.sum())
+        bw = (total_b / 1e6 / total_s) if total_s > 0 else 0.0
+        lines.append(
+            f"{name:<24}{recs[-1].get('direction', '?'):<6}"
+            f"{len(recs):>7}{total_b / 1e6:>10.3f}"
+            f"{durs.mean() * 1e3:>10.3f}"
+            f"{float(np.percentile(durs, 95)) * 1e3:>10.3f}"
+            f"{bw:>10.1f}")
+    return lines + [""]
+
+
+def _sebulba_section(transfers: List[dict],
+                     span_durations: Dict[str, List[float]]) -> List[str]:
+    """Actor/learner split accounting (rl/sebulba.py, loop_mode=
+    "sebulba"): only renders when the run carried cross-mesh hops
+    (``l2a`` params broadcasts or ``a2l`` trajectory stagings). Reports
+    each hop's count/bytes/mean alongside the per-sub-mesh busy time
+    (actor = train.collect, learner = train.update_device) — on one
+    socket of virtual devices the two CANNOT overlap, so the busy-time
+    ratio is the honest number, not a speedup claim
+    (docs/perf_round12.md)."""
+    hops = [r for r in transfers
+            if r.get("direction") in ("l2a", "a2l")]
+    if not hops:
+        return []
+    lines = ["== sebulba cross-mesh hops (explicit device_put only) ==",
+             f"{'hop':<24}{'dir':<6}{'count':>7}{'total_MB':>10}"
+             f"{'mean_ms':>10}"]
+    by_name: Dict[str, List[dict]] = defaultdict(list)
+    for rec in hops:
+        by_name[rec.get("name", "?")].append(rec)
+    for name in sorted(by_name):
+        recs = by_name[name]
+        durs = np.asarray([float(r.get("dur_s", 0.0)) for r in recs])
+        total_b = sum(int(r.get("bytes", 0)) for r in recs)
+        lines.append(f"{name:<24}{recs[-1].get('direction', '?'):<6}"
+                     f"{len(recs):>7}{total_b / 1e6:>10.3f}"
+                     f"{durs.mean() * 1e3:>10.3f}")
+    actor_s = sum(span_durations.get("train.collect", []))
+    learner_s = sum(span_durations.get("train.update_device", []))
+    if actor_s or learner_s:
+        lines += ["",
+                  f"{'actor_mesh_busy_s':<28}{actor_s:>10.3f}"
+                  "  (train.collect)",
+                  f"{'learner_mesh_busy_s':<28}{learner_s:>10.3f}"
+                  "  (train.update_device)"]
+        if learner_s > 0:
+            lines.append(f"{'actor/learner_ratio':<28}"
+                         f"{actor_s / learner_s:>10.3f}")
+    return lines + [""]
+
+
 def _ring_section(sections: Dict[str, Dict[str, Any]]) -> List[str]:
     """Trajectory-ring ledger rollup (rl/ring.py, ISSUE 15): lease/
     stall/publish/release counters, the lease-time occupancy histogram
@@ -298,6 +376,7 @@ def render_report(path: str) -> List[str]:
     event_counts: Dict[tuple, int] = defaultdict(int)
     event_last: Dict[tuple, dict] = {}
     flight_events: List[dict] = []
+    transfers: List[dict] = []
     last_snapshot: Dict[str, Any] = {}
     n_lines = n_bad = 0
     with open(path) as f:
@@ -327,6 +406,8 @@ def render_report(path: str) -> List[str]:
                 last_snapshot = rec.get("data") or {}
             elif kind == "flight":
                 flight_events.append(rec)
+            elif kind == "transfer":
+                transfers.append(rec)
 
     lines = [f"telemetry report: {path} ({n_lines} records"
              + (f", {n_bad} unparseable" if n_bad else "") + ")", ""]
@@ -336,6 +417,9 @@ def render_report(path: str) -> List[str]:
         lines += [""]
     if span_intervals:
         lines += _overlap_section(span_intervals)
+    if transfers:
+        lines += _transfer_section(transfers)
+        lines += _sebulba_section(transfers, span_durations)
     if flight_events:
         lines += _flight_section(flight_events)
     if event_counts:
@@ -399,10 +483,26 @@ def render_report(path: str) -> List[str]:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Summarize a telemetry JSONL sink file")
-    parser.add_argument("path", help="JSONL file written via "
-                                     "--telemetry-jsonl / "
-                                     "DDLS_TELEMETRY_JSONL")
+    parser.add_argument("path", nargs="?", default=None,
+                        help="JSONL file written via --telemetry-jsonl / "
+                             "DDLS_TELEMETRY_JSONL")
+    parser.add_argument("--timeline", nargs="+", metavar="RUN_DIR",
+                        default=None,
+                        help="instead of a report: merge RunLedger run "
+                             "directories into one Perfetto trace "
+                             "(telemetry/timeline.py)")
+    parser.add_argument("-o", "--out", default="timeline.json",
+                        help="output path for --timeline")
     args = parser.parse_args(argv)
+    if args.timeline:
+        from ddls_tpu.telemetry.timeline import write_timeline
+
+        doc = write_timeline(args.timeline, args.out)
+        print(f"wrote {args.out} ({len(doc['traceEvents'])} events from "
+              f"{len(args.timeline)} run dir(s))")
+        return 0
+    if not args.path:
+        parser.error("path is required unless --timeline is given")
     if not os.path.exists(args.path):
         print(f"error: no such file: {args.path}", file=sys.stderr)
         return 2

@@ -968,15 +968,20 @@ def test_restricted_run_skips_other_rules_bad_suppressions(tmp_path):
 
 # ------------------------------------------------- whole-tree / tier-1
 def expected_tree_files():
+    # the default run's scan surface: ddls_tpu/ plus the bare-timers
+    # rule's extra_roots ("scripts" — every other rule is gated off
+    # those files, but they are parsed once like any other)
     out = []
-    for dirpath, dirnames, filenames in os.walk(
-            os.path.join(REPO, "ddls_tpu")):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            rel = os.path.relpath(os.path.join(dirpath, fn), REPO)
-            rel = rel.replace(os.sep, "/")
-            if fn.endswith(".py") and not rel.startswith("ddls_tpu/lint/"):
-                out.append(rel)
+    for root in ("ddls_tpu", "scripts"):
+        for dirpath, dirnames, filenames in os.walk(
+                os.path.join(REPO, root)):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                rel = os.path.relpath(os.path.join(dirpath, fn), REPO)
+                rel = rel.replace(os.sep, "/")
+                if fn.endswith(".py") and not rel.startswith(
+                        "ddls_tpu/lint/"):
+                    out.append(rel)
     return out
 
 

@@ -471,3 +471,161 @@ def test_bench_sim_mode_emits_telemetry_section(capsys):
     counters = tele.get("counters", {})
     assert any(k.startswith("sim.lookahead_cache.") for k in counters), \
         counters
+
+
+# =============================== transfer ledger + run ledger (ISSUE 18)
+def test_transfer_disabled_guard():
+    """Disabled ``telemetry.transfer`` is the shared NullSpan: zero
+    metric objects, zero sink records, and ``add()`` swallows any tree
+    — the transfer ledger compiles into hot paths for free."""
+    assert not telemetry.enabled()
+    tr = telemetry.transfer("stage.traj", "h2d")
+    assert tr is telemetry.NULL_SPAN
+    assert telemetry.transfer("drain.metrics", "d2h") is tr
+    with tr as t:
+        t.add({"obs": np.zeros(64)})
+    assert t.bytes == 0
+    reg = telemetry.registry()
+    assert not reg._counters and not reg._histograms and not reg._spans
+    assert telemetry.snapshot() == {}
+
+
+def test_transfer_records_bytes_counters_and_sink(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    t = {"now": 10.0}
+    telemetry.enable(sink_path=path, clock=lambda: t["now"],
+                     record_intervals=True)
+    with telemetry.transfer("sebulba.params", "l2a") as tr:
+        t["now"] += 0.05
+        tr.add({"w": np.zeros((4, 4), dtype=np.float32)})   # 64 B
+        tr.add([np.zeros(16, dtype=np.float64)])            # 128 B
+    assert tr.bytes == 192
+    assert tr.duration_s == pytest.approx(0.05)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["transfer.sebulba.params.calls"] == 1
+    assert snap["counters"]["transfer.sebulba.params.bytes"] == 192
+    assert snap["counters"]["transfer.l2a.bytes"] == 192
+    assert snap["spans"]["transfer.sebulba.params"]["count"] == 1
+    # the interval ring carries the transfer like any span (timeline fuel)
+    assert any(n == "transfer.sebulba.params"
+               for n, _, _ in telemetry.span_intervals())
+    telemetry.registry().sink.close()
+    recs = [json.loads(line) for line in open(path) if line.strip()]
+    tr_recs = [r for r in recs if r.get("type") == "transfer"]
+    assert len(tr_recs) == 1
+    assert tr_recs[0]["name"] == "sebulba.params"
+    assert tr_recs[0]["direction"] == "l2a"
+    assert tr_recs[0]["bytes"] == 192
+    assert tr_recs[0]["dur_s"] == pytest.approx(0.05)
+    # the report script renders the transfer + cross-mesh sections
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "telemetry_report.py"), path],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "transfers (gated ledger" in out.stdout
+    assert "sebulba cross-mesh hops" in out.stdout
+
+
+def test_tree_nbytes_nested_and_without_jax(monkeypatch):
+    from ddls_tpu.telemetry import tree_nbytes
+
+    tree = {"a": np.zeros(10, np.float32),
+            "b": [np.zeros((2, 2), np.float64),
+                  {"c": np.zeros(3, np.int32)}],
+            "d": 7}
+    want = 40 + 32 + 12  # the int leaf has no nbytes
+    assert tree_nbytes(tree) == want
+    # container-walk fallback when jax is absent (worker processes that
+    # never import it) must agree
+    monkeypatch.setitem(sys.modules, "jax", None)
+    assert tree_nbytes(tree) == want
+
+
+# -------------------------------------------------- aggregate_snapshots
+def test_aggregate_snapshots_exact_merge():
+    from ddls_tpu.telemetry import aggregate_snapshots
+
+    t = {"now": 0.0}
+    r1 = telemetry.Registry(enabled=True, clock=lambda: t["now"])
+    r2 = telemetry.Registry(enabled=True, clock=lambda: t["now"])
+    r1.counter("c").inc(2)
+    r2.counter("c").inc(3)
+    r2.counter("only2").inc(1)
+    r1.gauge("g").set(1.0)
+    r2.gauge("g").set(2.5)
+    for v in (0.01, 0.02):
+        r1.histogram("h").observe(v)
+    r2.histogram("h").observe(0.04)
+    with r1.span("s"):
+        t["now"] += 0.1
+    with r2.span("s"):
+        t["now"] += 0.3
+    merged = aggregate_snapshots([r1.snapshot(), {}, r2.snapshot()])
+    assert merged["counters"] == {"c": 5, "only2": 1}
+    assert merged["gauges"]["g"] == 3.5
+    h = merged["histograms"]["h"]
+    assert h["count"] == 3
+    assert h["sum"] == pytest.approx(0.07)
+    assert h["min"] == 0.01 and h["max"] == 0.04
+    # percentiles reconstructed from the merged lifetime buckets
+    assert h["p50"] is not None and h["min"] <= h["p50"] <= h["max"]
+    s = merged["spans"]["s"]
+    assert s["count"] == 2
+    assert s["total_s"] == pytest.approx(0.4)
+    assert s["mean_ms"] == pytest.approx(200.0)
+    # window percentiles cannot merge order-faithfully: dropped
+    assert "p50_ms" not in s
+
+
+def test_aggregate_snapshots_empty_and_partial():
+    from ddls_tpu.telemetry import aggregate_snapshots
+
+    assert aggregate_snapshots([]) == {}
+    assert aggregate_snapshots([{}, {}]) == {}
+    # sections missing entirely (a counters-only registry) merge fine
+    merged = aggregate_snapshots([{"counters": {"a": 1}},
+                                  {"gauges": {"g": 2.0}}])
+    assert merged == {"counters": {"a": 1}, "gauges": {"g": 2.0}}
+
+
+# ----------------------------------- report robustness on partial sinks
+def _run_report(path):
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "scripts", "telemetry_report.py"), str(path)],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_report_script_on_sinks_missing_sections(tmp_path):
+    """The report renders every sink shape without crashing: events
+    only (no ring/flight/snapshot), a fleet-only snapshot, and a
+    snapshot whose histograms carry buckets but no window percentiles
+    (foreign/merged snapshots)."""
+    events_only = tmp_path / "events.jsonl"
+    events_only.write_text(
+        json.dumps({"type": "event", "kind": "tpu_probe",
+                    "phase": "ok", "ts": 1.0}) + "\n")
+    out = _run_report(events_only)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "== events ==" in out.stdout
+
+    fleet_only = tmp_path / "fleet.jsonl"
+    fleet_only.write_text(json.dumps({
+        "type": "snapshot", "ts": 2.0, "data": {"serve": {
+            "r0": {"counters": {"serve.requests": 4}},
+            "r1": {"counters": {"serve.requests": 6}},
+            "aggregate": {"counters": {"serve.requests": 10}}}}}) + "\n")
+    out = _run_report(fleet_only)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "serving fleet" in out.stdout
+
+    bucket_only = tmp_path / "buckets.jsonl"
+    bucket_only.write_text(json.dumps({
+        "type": "snapshot", "ts": 3.0, "data": {"histograms": {
+            "h": {"count": 2, "sum": 0.03, "min": 0.01, "max": 0.02,
+                  "buckets": {"0.01": 1, "0.025": 1, "+inf": 0}}}}})
+        + "\n")
+    out = _run_report(bucket_only)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "histograms (last snapshot)" in out.stdout
