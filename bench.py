@@ -899,6 +899,192 @@ def run_impala_depth_bench(args) -> dict:
     }
 
 
+def run_partition_bench(args) -> dict:
+    """Param-partition layout A/B (ISSUE 19, docs/perf_round13.md): one
+    jitted PPO update per named layout of the partition-rule table
+    (``parallel/partition.py`` replicated / fsdp / tp), driven by the
+    SAME synthetic [T, B] trajectory tiled from one real canonical
+    observation — the update cost is model+shape bound, so the obs
+    content is irrelevant and the env stays out of the loop.
+
+    Measures the two things the layouts differ in: per-device peak live
+    state bytes (``live_bytes_per_device`` — aval metadata only, exact
+    on virtual CPU meshes where allocator telemetry is not) and learner
+    update throughput as env-steps/s consumed (batch env-steps per
+    blocked update wall). Timed in interleaved rounds with the lead
+    rotating (the collect-mode drift protocol); the per-round
+    fsdp/replicated and tp/replicated rate ratios ride the payload as
+    paired medians. On one socket of virtual CPU devices the sharded
+    matmuls and their collectives timeshare the same cores, so the
+    throughput ratios here are an overhead FLOOR — the ICI win needs
+    real multi-chip silicon (ROADMAP item 1); the bytes ratios are
+    exact everywhere. ``--model-scale wide`` is the over-budget config
+    tests/test_partition.py pins (replicated > 2 MiB/device, fsdp
+    under it); the headline value is fsdp's median round rate at the
+    chosen scale."""
+    import jax
+
+    from ddls_tpu.envs import RampJobPartitioningEnvironment
+    from ddls_tpu.models.policy import GNNPolicy, batched_policy_apply
+    from ddls_tpu.parallel import partition as pt
+    from ddls_tpu.rl.ppo import PPOConfig, PPOLearner
+
+    n_dev = len(jax.devices())
+    dataset_dir = _make_dataset()
+    env = RampJobPartitioningEnvironment(**make_env_kwargs(dataset_dir))
+    single = jax.tree_util.tree_map(np.asarray, env.reset(seed=0))
+    n_actions = int(single["action_mask"].shape[0])
+    # the wide config is the tests/test_partition.py over-budget model
+    # (docs/perf_round13.md table) so bench numbers and the acceptance
+    # test talk about the same architecture
+    scale_kwargs = {
+        "canonical": {},
+        "wide": dict(out_features_msg=64, out_features_hidden=128,
+                     out_features_node=64, out_features_graph=64,
+                     fcnet_hiddens=(512, 512)),
+    }[args.model_scale]
+    model = GNNPolicy(n_actions=n_actions, **scale_kwargs)
+    params = model.init(jax.random.PRNGKey(0), single)
+
+    B = max((args.num_envs // n_dev) * n_dev, n_dev)
+    T = args.rollout_length
+    batch = B * T
+    num_sgd_iter = min(args.num_sgd_iter, 10)  # CPU-pinned mode
+    cfg = PPOConfig(num_sgd_iter=num_sgd_iter,
+                    sgd_minibatch_size=min(128, batch),
+                    train_batch_size=batch)
+
+    def tile(v):
+        return np.ascontiguousarray(
+            np.broadcast_to(v, (T, B) + v.shape))
+
+    rng_np = np.random.RandomState(0)
+    traj = {"obs": {k: tile(v) for k, v in single.items()},
+            "actions": np.zeros((T, B), np.int32),  # 0 = always valid
+            "logp": np.log(np.full((T, B), 0.5, np.float32)),
+            "values": rng_np.randn(T, B).astype(np.float32),
+            "rewards": rng_np.randn(T, B).astype(np.float32),
+            "dones": rng_np.rand(T, B) < 0.1}
+    last_values = rng_np.randn(B).astype(np.float32)
+
+    layouts = ["replicated", "fsdp", "tp"]
+    skipped: dict = {}
+    arms: dict = {}
+    for i, layout in enumerate(list(layouts)):
+        try:
+            mesh = pt.mesh_for_layout(n_dev, layout,
+                                      args.tp_size if layout == "tp"
+                                      else None)
+        except ValueError as e:
+            # e.g. tp on a 1-device run: record why, keep the line
+            skipped[layout] = str(e)
+            layouts.remove(layout)
+            continue
+        learner = PPOLearner(
+            lambda p, o, m=model: batched_policy_apply(m, p, o),
+            cfg, mesh, param_sharding=layout)
+        state = learner.init_state(params)
+        # staged ONCE per layout: this mode pins the CPU backend, where
+        # jit donation is disabled, so the staged batch survives updates
+        straj, slv = learner.shard_traj(traj, last_values)
+        arms[layout] = {
+            "learner": learner, "state": state,
+            "straj": straj, "slv": slv,
+            "rng": jax.random.PRNGKey(i),
+            "mesh_shape": dict(mesh.shape),
+            "state_bytes": pt.live_bytes_per_device(state),
+            "params_bytes": pt.live_bytes_per_device(state.params),
+        }
+
+    telemetry.enable()
+    with telemetry.span("bench.warmup"):  # one compile per layout
+        for a in arms.values():
+            a["rng"], sub = jax.random.split(a["rng"])
+            a["state"], metrics = a["learner"].train_step(
+                a["state"], a["straj"], a["slv"], sub)
+            jax.block_until_ready(metrics["total_loss"])
+
+    acc = {layout: {"steps": 0, "wall": 0.0, "rates": []}
+           for layout in layouts}
+    start = time.perf_counter()
+    completed_rounds = 0
+    for r in range(args.partition_rounds):
+        if time.perf_counter() - start > 0.8 * args.budget_seconds:
+            break  # the JSON line must land inside the driver budget
+        order = layouts if r % 2 else list(reversed(layouts))
+        for layout in order:
+            a, arm = acc[layout], arms[layout]
+            arm["rng"], sub = jax.random.split(arm["rng"])
+            with telemetry.span(f"bench.run_{layout}") as span:
+                arm["state"], metrics = arm["learner"].train_step(
+                    arm["state"], arm["straj"], arm["slv"], sub)
+                jax.block_until_ready(metrics["total_loss"])
+            a["steps"] += batch
+            a["wall"] += span.duration_s
+            a["rates"].append(batch / span.duration_s)
+        completed_rounds += 1
+    if not completed_rounds:
+        raise RuntimeError(
+            f"no timed rounds completed (partition_rounds="
+            f"{args.partition_rounds}, budget_seconds="
+            f"{args.budget_seconds}) — nothing to report")
+
+    results = {}
+    repl_bytes = arms.get("replicated", {}).get("state_bytes")
+    for layout in layouts:
+        a, arm = acc[layout], arms[layout]
+        rates = np.asarray(a["rates"])
+        results[layout] = {
+            "env_steps_per_sec": round(a["steps"] / a["wall"], 2),
+            "median_round_env_steps_per_sec": round(
+                float(np.median(rates)), 2),
+            "per_round_env_steps_per_sec": [round(float(x), 2)
+                                            for x in rates],
+            "update_ms": round(a["wall"] / len(a["rates"]) * 1e3, 2),
+            "state_bytes_per_device": arm["state_bytes"],
+            "params_bytes_per_device": arm["params_bytes"],
+            "mesh": arm["mesh_shape"],
+        }
+        if repl_bytes and layout != "replicated":
+            results[layout]["state_bytes_vs_replicated"] = round(
+                arm["state_bytes"] / repl_bytes, 4)
+    headline = "fsdp" if "fsdp" in results else layouts[0]
+    payload = {
+        "metric": "partition_update_env_steps_per_sec",
+        "value": results[headline]["median_round_env_steps_per_sec"],
+        "unit": "env_steps/s",
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "platform": jax.devices()[0].platform,
+        "headline_layout": headline,
+        "model_scale": args.model_scale,
+        "n_devices": n_dev,
+        "tp_size": args.tp_size if "tp" in results else None,
+        "num_envs": B,
+        "rollout_length": T,
+        "num_sgd_iter": num_sgd_iter,
+        "batch_env_steps": batch,
+        "layouts": results,
+        "layouts_skipped": skipped or None,
+        "timed_rounds": completed_rounds,
+        "timed_rounds_requested": args.partition_rounds,
+        "virtual_devices": jax.devices()[0].platform == "cpu",
+        "throughput_caveat": (
+            "virtual CPU devices timeshare one socket: sharded-layout "
+            "rate ratios are an overhead floor, not the ICI win"
+            if jax.devices()[0].platform == "cpu" else None),
+        "cores": _available_cores(),
+        "telemetry": telemetry.snapshot(),
+    }
+    for layout in ("fsdp", "tp"):
+        if layout in acc and "replicated" in acc and acc[layout]["rates"]:
+            paired = [s / p for s, p in zip(acc[layout]["rates"],
+                                           acc["replicated"]["rates"])]
+            payload[f"{layout}_speedup_vs_replicated"] = round(
+                float(np.median(paired)), 3)
+    return payload
+
+
 def run_jaxenv_bench(args) -> dict:
     """Fully-jitted episode throughput (sim/jax_env.py): ONE device
     dispatch runs a whole padded episode, so the tunnelled per-step RTT
@@ -1995,7 +2181,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--mode",
                         choices=("ppo", "sim", "jaxenv", "serve",
-                                 "collect", "impala"),
+                                 "collect", "impala", "partition"),
                         default="ppo",
                         help="ppo: full train loop; sim: pure env "
                              "stepping; jaxenv: fully-jitted episodes; "
@@ -2005,7 +2191,28 @@ def main(argv=None) -> int:
                              "(rollout collection only, no learner); "
                              "impala: interleaved pipeline-depth A/B of "
                              "the IMPALA loop on the trajectory ring "
-                             "(depths 0/1/--pipeline-depth, rl/ring.py)")
+                             "(depths 0/1/--pipeline-depth, rl/ring.py); "
+                             "partition: interleaved param-layout A/B "
+                             "of the PPO update (replicated/fsdp/tp, "
+                             "parallel/partition.py — env-steps/s + "
+                             "peak live bytes per device per layout)")
+    parser.add_argument("--model-scale", choices=("canonical", "wide"),
+                        default="canonical",
+                        help="partition mode's GNN config: canonical "
+                             "(the checkpoint family) or wide (the "
+                             "tests/test_partition.py over-budget "
+                             "model — msg/node/graph 64, hidden 128, "
+                             "fcnet 512x512)")
+    parser.add_argument("--tp-size", type=int, default=2,
+                        help="partition mode: mp-axis width of the tp "
+                             "layout's (dp, mp) mesh (must divide the "
+                             "device count; tp is skipped — with the "
+                             "reason recorded — where it cannot)")
+    parser.add_argument("--partition-rounds", type=int, default=6,
+                        help="partition mode: interleaved timed rounds "
+                             "(one blocked update per layout per round, "
+                             "lead rotating; paired per-round ratios "
+                             "give the drift-controlled comparison)")
     parser.add_argument("--pipeline-depth", type=int, default=2,
                         help="impala mode: the depth-K arm of the A/B "
                              "(>= 2; depth 1 runs the pre-ring "
@@ -2304,6 +2511,26 @@ def _dispatch_mode(args, process_start: float) -> int:
             emit({"metric": "impala_env_steps_per_sec", "value": None,
                   "unit": "env_steps/s", "vs_baseline": None,
                   "error": " | ".join(tb[-3:])})
+            return 1
+
+    if args.mode == "partition":
+        # layout A/B on the CPU backend: the tunnelled TPU is ONE chip
+        # (nothing to shard over) and the virtual 8-device CPU mesh is
+        # where the bytes accounting and overhead floor are measured;
+        # like impala mode, jitted updates run, so pin via
+        # jax.config.update (the axon sitecustomize gotcha, CLAUDE.md)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            emit(run_partition_bench(args))
+            return 0
+        except Exception:
+            tb = traceback.format_exc().strip().splitlines()
+            emit({"metric": "partition_update_env_steps_per_sec",
+                  "value": None, "unit": "env_steps/s",
+                  "vs_baseline": None, "error": " | ".join(tb[-3:])})
             return 1
 
     # a fused ppo run owns the chip end-to-end: hold .probe/tpu.lock for

@@ -11,11 +11,26 @@ that defines ``setup()`` must have a frozen-name entry in
 a new class or a changed name set fails lint until the config entry is
 deliberately updated — which is the checkpoint-compatibility review this
 rule exists to force.
+
+The partition-rule table (``ddls_tpu/parallel/partition.py``) is the
+other face of the same contract: its regexes NAME the frozen param-tree
+paths, so a renamed module or a typo'd rule silently stops sharding
+what it claims to shard. Any module assigning ``PARTITION_RULES`` is
+cross-validated against its ``CANONICAL_PARAM_PATHS`` literal, purely
+from the AST (the lint engine never imports linted code): every rule
+regex must match >= 1 canonical path (a stale rule is an error), every
+canonical path must match some rule of every layout (placement is
+exhaustive by construction — ``match_partition_rules`` raises at
+runtime; lint catches it first), and every ``LARGE_KERNEL_PATHS`` entry
+must FIRST-match a rule that actually names a mesh axis in each
+non-replicated layout (an uncovered large leaf would silently
+replicate the very kernels the layout exists to shard).
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List
+import re
+from typing import Dict, List, Optional
 
 from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
 
@@ -37,20 +52,74 @@ def _setup_assigned_names(setup: ast.FunctionDef) -> Dict[str, int]:
     return names
 
 
+def _top_level_nodes(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Top-level single-Name assignments -> their value node."""
+    out: Dict[str, ast.AST] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            out[node.targets[0].id] = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            out[node.target.id] = node.value
+    return out
+
+
+def _const_str(node: ast.AST, env: Dict[str, str]) -> Optional[str]:
+    """String value of a literal or a module-level str-constant Name."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    return None
+
+
+def _str_tuple(node: ast.AST, env: Dict[str, str]) -> Optional[List[str]]:
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for el in node.elts:
+        s = _const_str(el, env)
+        if s is None:
+            return None
+        out.append(s)
+    return out
+
+
+def _spec_names_axis(node: ast.AST) -> bool:
+    """True when a ``P(...)``/``PartitionSpec(...)`` call literal names at
+    least one real mesh axis (a non-None positional arg) — i.e. the rule
+    actually SHARDS rather than replicates."""
+    if not isinstance(node, ast.Call):
+        return False
+    for arg in node.args:
+        if not (isinstance(arg, ast.Constant) and arg.value is None):
+            return True
+    return False
+
+
 class FrozenParamTreeRule(Rule):
     id = "frozen-param-tree"
     pointer = ("setup() attribute names ARE the checkpoint param-tree "
                "paths — keep them equal to the frozen list in "
                "[tool.ddls_lint.frozen-param-tree.classes]; changing "
                "them means every shipped checkpoint must be migrated "
-               "(CLAUDE.md batched_policy_apply invariant)")
-    scope_dirs = ("ddls_tpu/models/",)
+               "(CLAUDE.md batched_policy_apply invariant); the "
+               "partition-rule table in parallel/partition.py must name "
+               "those same paths (stale rule / uncovered large leaf = "
+               "error)")
+    scope_dirs = ("ddls_tpu/models/", "ddls_tpu/parallel/")
 
     def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
-        if sf.tree is None or "def setup" not in sf.text:
+        if sf.tree is None:
             return []
+        findings: List[Finding] = []
+        if "PARTITION_RULES" in sf.text:
+            findings += self._check_partition_table(sf)
+        if "def setup" not in sf.text:
+            findings.sort(key=lambda f: f.line)
+            return findings
         classes = ctx.config.rule(self.id).get("classes", {})
-        findings = []
         for node in ast.walk(sf.tree):
             if not isinstance(node, ast.ClassDef):
                 continue
@@ -91,3 +160,83 @@ class FrozenParamTreeRule(Rule):
         return self.validate_allow_keys(
             ctx, ctx.config.rule(self.id).get("classes", {}),
             want_qualname=True, table=".classes", entity="class")
+
+    def _check_partition_table(self, sf: SourceFile) -> List[Finding]:
+        """AST cross-validation of a module's ``PARTITION_RULES`` against
+        its ``CANONICAL_PARAM_PATHS``/``LARGE_KERNEL_PATHS`` literals
+        (parallel/partition.py) — no import of the linted module."""
+        top = _top_level_nodes(sf.tree)
+        rules_node = top.get("PARTITION_RULES")
+        if not isinstance(rules_node, ast.Dict):
+            return []
+        # module-level str constants (FSDP_AXIS/TP_AXIS) for Name refs
+        env = {name: node.value for name, node in top.items()
+               if isinstance(node, ast.Constant)
+               and isinstance(node.value, str)}
+        findings: List[Finding] = []
+        paths = _str_tuple(top.get("CANONICAL_PARAM_PATHS",
+                                   ast.Constant(value=None)), env)
+        if paths is None:
+            return [Finding(
+                self.id, sf.rel, rules_node.lineno,
+                "PARTITION_RULES without a literal CANONICAL_PARAM_PATHS "
+                "tuple — the rule table cannot be cross-validated "
+                "against the frozen param-tree paths")]
+        large = _str_tuple(top.get("LARGE_KERNEL_PATHS",
+                                   ast.Constant(value=None)), env) or []
+        for lk in large:
+            if lk not in paths:
+                findings.append(Finding(
+                    self.id, sf.rel, rules_node.lineno,
+                    f"LARGE_KERNEL_PATHS entry '{lk}' is not a "
+                    "CANONICAL_PARAM_PATHS member — stale path (renamed "
+                    "module?)"))
+        for key_node, val_node in zip(rules_node.keys, rules_node.values):
+            layout = _const_str(key_node, env)
+            if layout is None or not isinstance(val_node,
+                                                (ast.Tuple, ast.List)):
+                continue
+            rules = []  # (lineno, regex, names_axis) in table order
+            for el in val_node.elts:
+                if not (isinstance(el, (ast.Tuple, ast.List))
+                        and len(el.elts) == 2):
+                    continue
+                pat = _const_str(el.elts[0], env)
+                if pat is None:
+                    continue
+                try:
+                    rx = re.compile(pat)
+                except re.error as e:
+                    findings.append(Finding(
+                        self.id, sf.rel, el.lineno,
+                        f"PARTITION_RULES[{layout!r}] regex {pat!r} does "
+                        f"not compile: {e}"))
+                    continue
+                rules.append((el.lineno, pat, rx,
+                              _spec_names_axis(el.elts[1])))
+            for lineno, pat, rx, _ in rules:
+                if not any(rx.search(p) for p in paths):
+                    findings.append(Finding(
+                        self.id, sf.rel, lineno,
+                        f"PARTITION_RULES[{layout!r}] rule {pat!r} "
+                        "matches no CANONICAL_PARAM_PATHS entry — stale "
+                        "rule (param-tree path renamed or typo'd regex)"))
+            for p in paths:
+                first = next((r for r in rules if r[2].search(p)), None)
+                if first is None:
+                    findings.append(Finding(
+                        self.id, sf.rel, rules_node.lineno,
+                        f"PARTITION_RULES[{layout!r}] covers no rule for "
+                        f"canonical path '{p}' — match_partition_rules "
+                        "would raise at runtime; add a rule (or a "
+                        "replicate-P() fallback)"))
+                elif layout != "replicated" and p in large \
+                        and not first[3]:
+                    findings.append(Finding(
+                        self.id, sf.rel, first[0],
+                        f"PARTITION_RULES[{layout!r}]: large kernel "
+                        f"'{p}' first-matches the replicate rule "
+                        f"{first[1]!r} — the layout silently leaves its "
+                        "biggest leaf unsharded; order a sharding rule "
+                        "(P with a mesh axis) ahead of it"))
+        return findings

@@ -147,9 +147,15 @@ def shard_batch(mesh: Mesh, tree, batch_axis: int = 0,
     return jax.tree_util.tree_map(put, tree)
 
 
-def place_state_tree(tree, shardings):
+def place_state_tree(tree, shardings, mesh: Optional[Mesh] = None):
     """Place a process-identical host pytree (train state) onto its
     shardings — the multi-host-safe ``device_put``.
+
+    ``shardings`` may also be a ``PartitionSpec`` tree (the partition-rule
+    engine's vocabulary, ``parallel/partition.py``) when ``mesh`` is given:
+    each spec leaf is wrapped into a ``NamedSharding`` on that mesh before
+    placement, so callers can hand the declarative spec table straight to
+    the placement layer.
 
     Single-process this IS ``jax.device_put`` (same aliasing/donation
     semantics, bit-identical path). Multi-process, ``device_put`` onto a
@@ -169,6 +175,14 @@ def place_state_tree(tree, shardings):
     (``mp_tree_shardings``) have each process slice ITS shards out of
     its full local copy.
     """
+    if mesh is not None:
+        if isinstance(shardings, P):
+            shardings = NamedSharding(mesh, shardings)
+        else:
+            shardings = jax.tree_util.tree_map(
+                lambda s: (NamedSharding(mesh, s)
+                           if isinstance(s, P) else s),
+                shardings, is_leaf=lambda s: isinstance(s, P))
     if jax.process_count() == 1:
         return jax.device_put(tree, shardings)
     from jax.sharding import Sharding

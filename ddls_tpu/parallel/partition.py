@@ -1,0 +1,328 @@
+"""Partition rules: one declarative sharding vocabulary for the learner.
+
+The Podracer recipe this stack follows (PAPERS.md, arXiv 2104.06272)
+gets model scale from a pjit'd learner whose params are SHARDED over
+the mesh rather than replicated.  This module is the single home of
+that placement decision: a ``match_partition_rules``-style engine
+(regex over ``/``-joined param-tree paths -> ``PartitionSpec``) plus a
+declarative rule table for the GNN param tree with three NAMED LAYOUTS:
+
+``replicated``
+    Today's behaviour, the default.  ``state_shardings`` returns the
+    exact ``replicated_sharding(mesh)`` object the learners always
+    used, so the compiled program, the jit-cache key and every bit of
+    the update are IDENTICAL to the pre-partition code path.
+
+``fsdp``
+    ZeRO-3 over the data axis: the Dense kernels are sharded along
+    their INPUT-feature (first) dim over the existing ``dp`` axis —
+    the same devices that shard the batch also shard the params and
+    the adam moments, so per-device state bytes drop by the dp width
+    and GSPMD emits the all-gather (forward) / reduce-scatter (grads)
+    pairs from the annotations alone.  No new mesh geometry: on the
+    1-axis training mesh the dp axis IS the fsdp axis (a dedicated
+    axis name would change geometry, not semantics).
+
+``tp``
+    Tensor parallelism per SNIPPETS [3]: kernels sharded along their
+    OUTPUT-feature (last) dim over a second mesh axis ``mp`` (the
+    ``mp_tree_shardings`` axis vocabulary), biases and LayerNorms
+    replicated.  Needs a 2-axis mesh — ``mesh_for_layout`` builds
+    ``("dp", "mp")``; a mesh without the axis raises with the fix.
+
+Matching is ``re.search`` over the ``/``-joined tree path, so ONE rule
+table covers a bare params dict and a whole TrainState alike: the adam
+``mu``/``nu`` moments mirror the params tree and their paths END with
+the same ``.../Dense_i/kernel`` suffix the rule names.  Scalar leaves
+(``step``, ``count``, ``kl_coeff`` — ndim 0 or size 1) are ALWAYS
+replicated before any rule is consulted; a non-scalar leaf no rule
+matches is a LOUD error, never a silent replicate.
+
+Leaves whose named dim does not divide the mesh axis fall back to
+replicated per leaf (deterministic in shapes — multi-host safe); the
+canonical checkpoint family therefore loads into ``fsdp``/``tp`` with
+its small kernels replicated and only the eligible ones sharded, while
+the frozen ``gnn/graph_module/logit_head/value_head`` names keep every
+shipped checkpoint loading into ``replicated`` unchanged.
+
+The lint engine's ``frozen-param-tree`` rule cross-validates the table
+below against ``CANONICAL_PARAM_PATHS`` (every rule matches >= 1 real
+path; every path is covered; every ``LARGE_KERNEL_PATHS`` entry
+first-matches a SHARDING rule in fsdp/tp) — a stale or typo'd regex
+fails lint before it can fail at init_state time.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddls_tpu.parallel.mesh import make_mesh, replicated_sharding
+
+#: the three named layouts of the train-config ``param_sharding`` knob
+LAYOUTS = ("replicated", "fsdp", "tp")
+
+#: fsdp shards over the data axis (ZeRO-3); tp over the second mesh axis
+FSDP_AXIS = "dp"
+TP_AXIS = "mp"
+
+#: canonical GNNPolicy param-tree paths (n_actions=17 checkpoint family;
+#: models/policy.py + models/gnn.py — the frozen setup() names).  The
+#: lint frozen-param-tree rule validates PARTITION_RULES against this
+#: list, so it must stay in sync with the canonical model: regenerate
+#: with ``tree_paths(model.init(...))`` when the architecture changes.
+CANONICAL_PARAM_PATHS = (
+    "gnn/round_0/edge_module/Dense_0/bias",
+    "gnn/round_0/edge_module/Dense_0/kernel",
+    "gnn/round_0/edge_module/LayerNorm_0/bias",
+    "gnn/round_0/edge_module/LayerNorm_0/scale",
+    "gnn/round_0/node_module/Dense_0/bias",
+    "gnn/round_0/node_module/Dense_0/kernel",
+    "gnn/round_0/node_module/LayerNorm_0/bias",
+    "gnn/round_0/node_module/LayerNorm_0/scale",
+    "gnn/round_0/reduce_module/Dense_0/bias",
+    "gnn/round_0/reduce_module/Dense_0/kernel",
+    "gnn/round_0/reduce_module/LayerNorm_0/bias",
+    "gnn/round_0/reduce_module/LayerNorm_0/scale",
+    "gnn/round_1/edge_module/Dense_0/bias",
+    "gnn/round_1/edge_module/Dense_0/kernel",
+    "gnn/round_1/edge_module/LayerNorm_0/bias",
+    "gnn/round_1/edge_module/LayerNorm_0/scale",
+    "gnn/round_1/node_module/Dense_0/bias",
+    "gnn/round_1/node_module/Dense_0/kernel",
+    "gnn/round_1/node_module/LayerNorm_0/bias",
+    "gnn/round_1/node_module/LayerNorm_0/scale",
+    "gnn/round_1/reduce_module/Dense_0/bias",
+    "gnn/round_1/reduce_module/Dense_0/kernel",
+    "gnn/round_1/reduce_module/LayerNorm_0/bias",
+    "gnn/round_1/reduce_module/LayerNorm_0/scale",
+    "graph_module/Dense_0/bias",
+    "graph_module/Dense_0/kernel",
+    "graph_module/LayerNorm_0/bias",
+    "graph_module/LayerNorm_0/scale",
+    "logit_head/Dense_0/bias",
+    "logit_head/Dense_0/kernel",
+    "logit_head/Dense_1/bias",
+    "logit_head/Dense_1/kernel",
+    "logit_head/Dense_2/bias",
+    "logit_head/Dense_2/kernel",
+    "value_head/Dense_0/bias",
+    "value_head/Dense_0/kernel",
+    "value_head/Dense_1/bias",
+    "value_head/Dense_1/kernel",
+    "value_head/Dense_2/bias",
+    "value_head/Dense_2/kernel",
+)
+
+#: the kernels that dominate state bytes (the MLP heads: 24x256 and
+#: 256x256 at canonical width, wider under --model-scale) — the lint
+#: rule requires each to first-match a rule with a REAL axis in the
+#: fsdp and tp tables (an "uncovered large leaf" is a lint error)
+LARGE_KERNEL_PATHS = (
+    "logit_head/Dense_0/kernel",
+    "logit_head/Dense_1/kernel",
+    "value_head/Dense_0/kernel",
+    "value_head/Dense_1/kernel",
+)
+
+#: the declarative layout tables: ordered (regex, PartitionSpec) pairs,
+#: FIRST re.search match wins.  Keep every entry a literal — the lint
+#: frozen-param-tree rule reads this table from the AST.
+PARTITION_RULES: Dict[str, Tuple[Tuple[str, P], ...]] = {
+    "replicated": (
+        (r".*", P()),
+    ),
+    "fsdp": (
+        # all Dense kernels: shard the input-feature (first) dim over
+        # dp; ineligible dims (canonical small kernels) fall back to
+        # replicated per leaf in specs_to_shardings
+        (r"Dense_\d+/kernel$", P(FSDP_AXIS, None)),
+        (r"LayerNorm_\d+/(scale|bias)$", P()),
+        (r"Dense_\d+/bias$", P()),
+    ),
+    "tp": (
+        # GNN + logit/value heads: shard the output-feature (last) dim
+        # over mp (SNIPPETS [3] layout); biases/LayerNorms replicated
+        (r"(logit_head|value_head)/Dense_\d+/kernel$", P(None, TP_AXIS)),
+        (r"(gnn|graph_module).*/Dense_\d+/kernel$", P(None, TP_AXIS)),
+        (r"LayerNorm_\d+/(scale|bias)$", P()),
+        (r"Dense_\d+/bias$", P()),
+    ),
+}
+
+
+# ----------------------------------------------------------- path utils
+def _path_str(key_path) -> str:
+    """One tree-path entry -> its ``/``-joined name: dict keys and
+    attribute names verbatim, sequence indices as their position (so an
+    optax chain's tuple levels read ``opt_state/1/0/mu/...``)."""
+    parts = []
+    for k in key_path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:  # pragma: no cover - future key kinds
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def tree_paths(tree) -> Tuple[str, ...]:
+    """The ``/``-joined path of every leaf, in flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return tuple(_path_str(p) for p, _ in flat)
+
+
+def _is_scalar(leaf) -> bool:
+    shp = getattr(leaf, "shape", ())
+    return len(shp) == 0 or int(np.prod(shp)) <= 1
+
+
+# --------------------------------------------------------- rule matching
+def match_partition_rules(rules: Sequence[Tuple[str, P]], tree):
+    """Assign a ``PartitionSpec`` to every leaf of ``tree``.
+
+    ``rules`` is an ordered sequence of ``(regex, PartitionSpec)``; the
+    FIRST rule whose ``re.search`` hits the leaf's ``/``-joined path
+    wins (the SNIPPETS [1] contract).  Scalar leaves (ndim 0 or size
+    <= 1) are always ``P()`` without consulting the rules; a non-scalar
+    leaf that no rule matches raises — placement must be exhaustive,
+    never an accidental replicate.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for key_path, leaf in flat:
+        name = _path_str(key_path)
+        if _is_scalar(leaf):
+            specs.append(P())
+            continue
+        for pat, spec in compiled:
+            if pat.search(name):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(
+                f"partition rule not found for param {name!r} "
+                f"(shape {tuple(getattr(leaf, 'shape', ()))}): every "
+                "non-scalar leaf must match a rule — extend the layout "
+                "table in ddls_tpu/parallel/partition.py")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def layout_axis(layout: str) -> Optional[str]:
+    """The mesh axis a layout shards over (None for replicated)."""
+    return {"replicated": None, "fsdp": FSDP_AXIS, "tp": TP_AXIS}[layout]
+
+
+def validate_layout(layout: str) -> str:
+    if layout not in LAYOUTS:
+        raise ValueError(
+            f"param_sharding must be one of {LAYOUTS}, got {layout!r}")
+    return layout
+
+
+def validate_mesh_for_layout(mesh: Mesh, layout: str) -> None:
+    """Loud contract edge: a layout naming an axis the mesh lacks is a
+    config error, not a silent replicate."""
+    axis = layout_axis(validate_layout(layout))
+    if axis is not None and axis not in mesh.shape:
+        raise ValueError(
+            f"param_sharding={layout!r} shards over mesh axis {axis!r}, "
+            f"but the mesh has axes {tuple(mesh.shape)} — build the "
+            f"mesh with partition.mesh_for_layout(n_devices, {layout!r})"
+            " (train/loops.py does this from the param_sharding knob)")
+
+
+def mesh_for_layout(n_devices: Optional[int], layout: str,
+                    tp_size: Optional[int] = None) -> Mesh:
+    """The training mesh a layout wants: the 1-D dp mesh for
+    ``replicated``/``fsdp`` (bit-identical to today's ``make_mesh``),
+    a ``("dp", "mp")`` mesh for ``tp`` with ``tp_size`` devices on the
+    tensor axis (default 2)."""
+    validate_layout(layout)
+    if layout != "tp":
+        return make_mesh(n_devices)
+    n = n_devices if n_devices is not None else len(jax.devices())
+    tp = int(tp_size or 2)
+    if tp < 2 or n % tp:
+        raise ValueError(
+            f"param_sharding='tp' needs tp_size >= 2 dividing the "
+            f"device count ({n}), got tp_size={tp}")
+    return make_mesh(n, ("dp", TP_AXIS), shape=(n // tp, tp))
+
+
+# ------------------------------------------------------ sharding trees
+def specs_to_shardings(mesh: Mesh, tree, specs):
+    """Spec tree -> NamedSharding tree over ``mesh``, with the per-leaf
+    divisibility fallback: a leaf whose named dim does not divide its
+    mesh axis (or whose rank is below the spec) is replicated.  Pure in
+    (shapes, specs) — identical on every process, multi-host safe."""
+
+    def to_sharding(leaf, spec):
+        shp = tuple(getattr(leaf, "shape", ()))
+        if not isinstance(spec, P):
+            return spec  # already a Sharding
+        if len(spec) > len(shp):
+            return NamedSharding(mesh, P())
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            names = axis if isinstance(axis, tuple) else (axis,)
+            width = int(np.prod([mesh.shape[a] for a in names]))
+            if shp[dim] % width:
+                return NamedSharding(mesh, P())
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map(to_sharding, tree, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def state_shardings(mesh: Mesh, state, layout: str):
+    """The ONE learner entry point: sharding (tree) for a whole
+    TrainState under a named layout.  ``replicated`` returns the single
+    ``replicated_sharding(mesh)`` object — the exact pre-partition
+    value, so default-layout jit keys, programs and bits are unchanged;
+    other layouts run the rule table over the state (params and adam
+    moments match the same suffix rules) with the divisibility
+    fallback applied."""
+    validate_mesh_for_layout(mesh, layout)
+    if layout == "replicated":
+        return replicated_sharding(mesh)
+    specs = match_partition_rules(PARTITION_RULES[layout], state)
+    return specs_to_shardings(mesh, state, specs)
+
+
+def params_shardings_of(state_sh, state=None):
+    """The params subtree of a state-shardings value: a single Sharding
+    passes through (replicated layouts), a state-shaped tree yields its
+    ``.params`` field — what collectors feed their jit in_shardings so
+    sharded params enter the forward WITHOUT an implicit reshard."""
+    from jax.sharding import Sharding
+
+    if isinstance(state_sh, Sharding):
+        return state_sh
+    return state_sh.params
+
+
+# ------------------------------------------------------- accounting
+def live_bytes_per_device(tree) -> int:
+    """Peak resident bytes any one device holds for ``tree``: the sum
+    over leaves of that device's SHARD bytes (aval metadata only — no
+    device sync, works on virtual CPU meshes where allocator telemetry
+    does not).  Replicated leaves count full size on every device;
+    sharded leaves 1/width — exactly the number the fsdp layout exists
+    to shrink (docs/perf_round13.md "peak live bytes method")."""
+    per_device: Dict[object, int] = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not isinstance(leaf, jax.Array):
+            continue
+        for shard in leaf.addressable_shards:
+            per_device[shard.device] = (per_device.get(shard.device, 0)
+                                        + int(shard.data.nbytes))
+    return max(per_device.values(), default=0)

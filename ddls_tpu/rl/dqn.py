@@ -223,10 +223,18 @@ class ApexDQNLearner:
     into (dueling) Q-values.
     """
 
-    def __init__(self, apply_fn: Callable, cfg: DQNConfig, mesh):
+    def __init__(self, apply_fn: Callable, cfg: DQNConfig, mesh,
+                 param_sharding: str = "replicated"):
+        if param_sharding != "replicated":
+            raise ValueError(
+                f"param_sharding={param_sharding!r} requires the device-"
+                "collection trajectory contract, which DQN does not "
+                "implement (replay-buffer learner); use "
+                "param_sharding='replicated' or a PPO/IMPALA/PG loop")
         self.apply_fn = apply_fn
         self.cfg = cfg
         self.mesh = mesh
+        self.param_sharding = param_sharding
         chain = []
         if cfg.grad_clip is not None:
             chain.append(optax.clip_by_global_norm(cfg.grad_clip))

@@ -76,11 +76,18 @@ class ESLearner:
     """
 
     def __init__(self, apply_fn: Callable, cfg: ESConfig, mesh,
-                 population: int):
+                 population: int, param_sharding: str = "replicated"):
+        if param_sharding != "replicated":
+            raise ValueError(
+                f"param_sharding={param_sharding!r} requires the device-"
+                "collection trajectory contract, which ES does not "
+                "implement (population perturbation learner); use "
+                "param_sharding='replicated' or a PPO/IMPALA/PG loop")
         if population % 2 != 0:
             raise ValueError(
                 f"ES population must be even (antithetic pairs), got "
                 f"{population}")
+        self.param_sharding = param_sharding
         self.apply_fn = apply_fn
         self.cfg = cfg
         self.mesh = mesh

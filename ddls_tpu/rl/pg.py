@@ -65,10 +65,18 @@ class PGLearner:
     """Collector-compatible REINFORCE learner (same interface as
     PPOLearner: ``sample_actions`` / ``shard_traj`` / ``train_step``)."""
 
-    def __init__(self, apply_fn: Callable, cfg: PGConfig, mesh):
+    def __init__(self, apply_fn: Callable, cfg: PGConfig, mesh,
+                 param_sharding: str = "replicated"):
         self.apply_fn = apply_fn
         self.cfg = cfg
         self.mesh = mesh
+        from ddls_tpu.parallel import partition as _partition
+
+        _partition.validate_layout(param_sharding)
+        if param_sharding != "replicated":
+            _partition.validate_mesh_for_layout(mesh, param_sharding)
+        self.param_sharding = param_sharding
+        self._partition = _partition
         chain = []
         if cfg.grad_clip is not None:
             chain.append(optax.clip_by_global_norm(cfg.grad_clip))
@@ -83,19 +91,41 @@ class PGLearner:
         # its buffers need not outlive the update
         from ddls_tpu.rl.ppo import traj_donate_argnums
 
+        self._donate = traj_donate_argnums(0, 1, 2)
+        # replicated jit built eagerly as before — bit-identical default
         self._jit_train_step = jax.jit(
             self._train_step,
             in_shardings=(self._replicated, self._batch_time,
                           self._batch_only),
             out_shardings=(self._replicated, self._replicated),
-            donate_argnums=traj_donate_argnums(0, 1, 2))
+            donate_argnums=self._donate)
+        self._jit_cache = {}
         self._jit_sample = jax.jit(self._sample_actions)
+
+    def _state_shardings(self, state):
+        if self.param_sharding == "replicated":
+            return self._replicated
+        return self._partition.state_shardings(
+            self.mesh, state, self.param_sharding)
 
     def init_state(self, params) -> PGState:
         params = jax.tree_util.tree_map(jnp.copy, params)
         state = PGState.create(params, self.tx)
+        shardings = self._state_shardings(state)
+        if self.param_sharding != "replicated":
+            key = (jax.tree_util.tree_structure(state),
+                   tuple(str(getattr(s, "spec", s)) for s in
+                         jax.tree_util.tree_leaves(shardings)))
+            if key not in self._jit_cache:
+                self._jit_cache[key] = jax.jit(
+                    self._train_step,
+                    in_shardings=(shardings, self._batch_time,
+                                  self._batch_only),
+                    out_shardings=(shardings, self._replicated),
+                    donate_argnums=self._donate)
+            self._jit_train_step = self._jit_cache[key]
         # multi-host-safe placement (see parallel/mesh.py:place_state_tree)
-        return place_state_tree(state, self._replicated)
+        return place_state_tree(state, shardings)
 
     def _sample_actions(self, params, obs, rng):
         logits, values = self.apply_fn(params, obs)

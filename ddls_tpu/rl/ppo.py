@@ -174,7 +174,8 @@ class PPOLearner:
     """
 
     def __init__(self, apply_fn: Callable, cfg: PPOConfig, mesh,
-                 shard_params_axis: str | None = None):
+                 shard_params_axis: str | None = None,
+                 param_sharding: str = "replicated"):
         self.apply_fn = apply_fn
         self.cfg = cfg
         self.mesh = mesh
@@ -185,6 +186,21 @@ class PPOLearner:
         # 1-D dp plan; the policy net is small enough that dp alone is
         # usually right — SURVEY §2.10 MP row)
         self.shard_params_axis = shard_params_axis
+        # declarative layout from the partition-rule table
+        # (parallel/partition.py): "replicated" keeps today's exact
+        # sharding objects (bit-identical jit programs); "fsdp"/"tp"
+        # assign PartitionSpecs by regex over param-tree paths
+        from ddls_tpu.parallel import partition as _partition
+
+        _partition.validate_layout(param_sharding)
+        if param_sharding != "replicated":
+            if shard_params_axis is not None:
+                raise ValueError(
+                    "pass either param_sharding or the legacy "
+                    "shard_params_axis, not both")
+            _partition.validate_mesh_for_layout(mesh, param_sharding)
+        self.param_sharding = param_sharding
+        self._partition = _partition
         chain = []
         if cfg.grad_clip is not None:
             chain.append(optax.clip_by_global_norm(cfg.grad_clip))
@@ -199,9 +215,13 @@ class PPOLearner:
         self._jit_sample = jax.jit(self._sample_actions)
 
     def _state_shardings(self, state):
-        """Sharding tree for a TrainState: replicated, or tp-sharded by the
-        shape-based rule (which covers params and their adam moments
-        identically)."""
+        """Sharding tree for a TrainState: replicated, rule-table sharded
+        (partition.state_shardings — regex over paths, so params and their
+        adam moments get identical specs via suffix matching), or
+        tp-sharded by the legacy shape-based rule."""
+        if self.param_sharding != "replicated":
+            return self._partition.state_shardings(
+                self.mesh, state, self.param_sharding)
         if self.shard_params_axis is None:
             return self._replicated
         from ddls_tpu.parallel.mesh import mp_tree_shardings

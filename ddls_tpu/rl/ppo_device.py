@@ -33,6 +33,14 @@ class DevicePPOCollector:
     slice would collect on one chip and update on all. Requires
     ``num_envs`` divisible by the dp axis size.
 
+    ``params_shardings`` (optional, mesh mode only) is the sharding tree
+    the learner keeps its params in (``parallel/partition.py`` — fsdp/tp
+    layouts); the collector's jitted forwards declare it as the params
+    in_sharding so sharded params enter the in-scan forward as-is (XLA
+    inserts the layout's gathers INSIDE the program) instead of being
+    implicitly replicated at dispatch. Default keeps today's replicated
+    in_sharding — bit-identical programs.
+
     ``memo_cfg`` wires the in-kernel lookahead memo (sim/jax_memo.py):
     ``"auto"`` (default) enables it at EVERY lane count — the batched
     probe masks hit lanes out of the lookahead while_loop, so the
@@ -42,7 +50,7 @@ class DevicePPOCollector:
     (drain boundaries only)."""
 
     def __init__(self, et, ot, model, banks: Dict, rollout_length: int,
-                 mesh=None, memo_cfg="auto"):
+                 mesh=None, memo_cfg="auto", params_shardings=None):
         import jax
         import jax.numpy as jnp
 
@@ -68,6 +76,10 @@ class DevicePPOCollector:
                     f"mesh dp axis ({mesh.shape['dp']})")
             lane = NamedSharding(mesh, P("dp"))
             repl = NamedSharding(mesh, P())
+            # fsdp/tp params enter with the learner's layout declared, so
+            # dispatch never implicitly replicates them (the gathers live
+            # inside the compiled program instead)
+            p_sh = repl if params_shardings is None else params_shardings
             banks = jax.device_put(banks, lane)
             # rngs/state arrive as host (or mismatched) arrays; jit's
             # explicit in_shardings reshards them on dispatch. The env
@@ -78,10 +90,14 @@ class DevicePPOCollector:
             # inline execution of the jitted call, ppo.traj_donate_argnums)
             self._vseg = jax.jit(
                 lane_segment,
-                in_shardings=(lane, repl, lane, lane),
+                in_shardings=(lane, p_sh, lane, lane),
                 out_shardings=(lane, lane, lane),
                 donate_argnums=traj_donate_argnums(2))
         else:
+            if params_shardings is not None:
+                raise ValueError(
+                    "params_shardings requires a mesh: the sharded-params "
+                    "layouts only exist on a device mesh")
             self._vseg = jax.jit(lane_segment,
                                  donate_argnums=traj_donate_argnums(2))
         self.banks = banks
@@ -102,7 +118,8 @@ class DevicePPOCollector:
 
             self._jit_apply = jax.jit(
                 lambda p, o: batched_policy_apply(model, p, o),
-                in_shardings=(NamedSharding(mesh, P()),
+                in_shardings=(p_sh if params_shardings is not None
+                              else NamedSharding(mesh, P()),
                               NamedSharding(mesh, P("dp"))))
         else:
             self._jit_apply = jax.jit(

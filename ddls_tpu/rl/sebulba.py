@@ -91,10 +91,18 @@ class SebulbaCollector:
 
     ``memo_cfg`` follows the device-collector contract: ``"auto"``
     enables the in-kernel lookahead memo at every lane count (the
-    round-12 batched probe — sim/jax_memo.py)."""
+    round-12 batched probe — sim/jax_memo.py).
+
+    ``param_layout`` names the LEARNER's partition-rule layout
+    (``parallel/partition.py``); the learner→actor hop always lands
+    replicated on the actor sub-mesh, so a sharded layout makes that
+    ``device_put`` a gather-to-actor-layout — the transfer-ledger name
+    carries the resolved layout (``sebulba.params[gather-from-fsdp]``)
+    so cross-mesh byte counts stay attributable per layout."""
 
     def __init__(self, et, ot, model, banks: Dict, rollout_length: int,
-                 actor_mesh, ring_segments: int = 2, memo_cfg="auto"):
+                 actor_mesh, ring_segments: int = 2, memo_cfg="auto",
+                 param_layout: str = "replicated"):
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -109,6 +117,12 @@ class SebulbaCollector:
         self.rollout_length = int(rollout_length)
         self.num_envs = int(jax.tree_util.tree_leaves(banks)[0].shape[0])
         self.mesh = actor_mesh
+        self.param_layout = str(param_layout)
+        # layout-attributed transfer name (telemetry_report groups the
+        # ledger by name, so the gather shows up as its own row)
+        self._params_hop_name = (
+            "sebulba.params" if self.param_layout == "replicated"
+            else f"sebulba.params[gather-from-{self.param_layout}]")
         self.memo_cfg = resolve_memo_cfg(memo_cfg, self.num_envs)
         B, T = self.num_envs, self.rollout_length
         if B % actor_mesh.shape["dp"] != 0:
@@ -184,7 +198,7 @@ class SebulbaCollector:
         # transfer-ledger wraps (gated; NULL_SPAN + no-op add when
         # telemetry is off) around the EXISTING explicit hops — byte
         # attribution is .nbytes metadata only, transfer-guard safe
-        with telemetry.transfer("sebulba.params", "l2a") as tr:
+        with telemetry.transfer(self._params_hop_name, "l2a") as tr:
             params = jax.device_put(params, self._repl)
             tr.add(params)
         with telemetry.transfer("sebulba.rngs", "h2d") as tr:

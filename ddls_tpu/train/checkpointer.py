@@ -64,6 +64,13 @@ def restore_train_state(path: str, target=None):
     ``target`` (a template state with matching structure) restores typed
     arrays; without it, the raw pytree is returned. Handles both backends
     (orbax dir or the multi-process single-writer pickle).
+
+    When ``target`` leaves are committed ``jax.Array``s, the restored
+    state is re-placed onto the target's SHARDINGS leaf-for-leaf — a
+    sharded-layout state (``parallel/partition.py`` fsdp/tp) restores
+    sharded, never silently de-sharded to host/default placement; the
+    replicated default round-trips through the same path bit-identically
+    (a ``device_put`` onto the sharding it was saved from).
     """
     pickled = Path(path).absolute() / "state.pkl.gz"
     if pickled.exists():
@@ -75,13 +82,33 @@ def restore_train_state(path: str, target=None):
         with gzip.open(pickled, "rb") as f:
             loaded = pickle.load(f)
         if target is not None:
-            return jax.tree_util.tree_unflatten(
+            return _reapply_shardings(jax.tree_util.tree_unflatten(
                 jax.tree_util.tree_structure(target),
-                jax.tree_util.tree_leaves(loaded))
+                jax.tree_util.tree_leaves(loaded)), target)
         return loaded
     import orbax.checkpoint as ocp
 
     ckptr = ocp.PyTreeCheckpointer()
     if target is not None:
-        return ckptr.restore(str(Path(path).absolute()), item=target)
+        return _reapply_shardings(
+            ckptr.restore(str(Path(path).absolute()), item=target),
+            target)
     return ckptr.restore(str(Path(path).absolute()))
+
+
+def _reapply_shardings(restored, target):
+    """Re-place restored leaves onto the target's shardings (single-
+    process ``device_put``; multi-process states are replicated-only —
+    train/loops.py rejects sharded layouts there — and ride the
+    collective-free ``place_state_tree`` contract at init instead)."""
+    import jax
+
+    if jax.process_count() > 1:
+        return restored
+
+    def put(r, t):
+        if isinstance(t, jax.Array) and t.committed:
+            return jax.device_put(r, t.sharding)
+        return r
+
+    return jax.tree_util.tree_map(put, restored, target)

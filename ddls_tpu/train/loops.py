@@ -245,6 +245,10 @@ class RLEpochLoop:
     # that traces as one pure function (state, traj, last_values, rng)
     # -> (state, metrics); DQN/ES opt out (host replay / host fitness)
     SUPPORTS_FUSED = True
+    # sharded param layouts (parallel/partition.py fsdp/tp) ride the
+    # device-collection trajectory contract; DQN/ES opt out (their
+    # host replay / population paths never consume the spec table)
+    SUPPORTS_PARAM_SHARDING = True
 
     def __init__(self,
                  path_to_env_cls: str,
@@ -270,12 +274,13 @@ class RLEpochLoop:
                  updates_per_epoch: int = 4,
                  fused_config: Optional[dict] = None,
                  sebulba_config: Optional[dict] = None,
+                 param_sharding: str = "replicated",
+                 tp_size: Optional[int] = None,
                  path_to_model_cls: Optional[str] = None,  # config parity
                  run_ledger=None,
                  **kwargs):
         import jax
 
-        from ddls_tpu.parallel.mesh import make_mesh
         from ddls_tpu.rl.rollout import ParallelVectorEnv, VectorEnv
 
         self.env_cls = get_class_from_path(path_to_env_cls)
@@ -321,6 +326,41 @@ class RLEpochLoop:
                 "batches over a process-local device ring (use "
                 "loop_mode='pipelined' with device_collector under "
                 "multi-host)")
+        # param layout knob (parallel/partition.py): validated BEFORE any
+        # env construction, the fused/sebulba loud-rejection convention
+        from ddls_tpu.parallel import partition as _partition
+
+        _partition.validate_layout(param_sharding)
+        self.param_sharding = param_sharding
+        self.tp_size = tp_size
+        if param_sharding != "replicated":
+            if not self.SUPPORTS_PARAM_SHARDING:
+                raise ValueError(
+                    f"{type(self).__name__} does not support "
+                    f"param_sharding={param_sharding!r}: the sharded "
+                    "layouts ride the device-collection trajectory "
+                    "contract — DQN's replay insertion and ES's "
+                    "population fitness never consume the spec table "
+                    "(use ppo/impala/pg, or param_sharding='replicated')")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    f"param_sharding={param_sharding!r} is single-"
+                    "process: the sharded state lives on one process's "
+                    "mesh — the multi-host identical-state placement "
+                    "contract (parallel/mesh.py:place_state_tree) only "
+                    "covers replicated layouts today (use "
+                    "param_sharding='replicated' under multi-host)")
+            if loop_mode == "sebulba" and param_sharding == "tp":
+                raise ValueError(
+                    "param_sharding='tp' cannot combine with "
+                    "loop_mode='sebulba': the actor/learner sub-meshes "
+                    "are 1-axis dp meshes (rl/sebulba.py:split_meshes) "
+                    "and have no 'mp' axis to shard over — use "
+                    "param_sharding='fsdp' or a non-split loop_mode")
+            # fail fast on an infeasible mesh for the layout (e.g. a tp
+            # factorisation that does not divide the device count)
+            _partition.mesh_for_layout(n_devices, param_sharding,
+                                       tp_size)
         self.loop_mode = loop_mode
         self.updates_per_epoch = max(int(updates_per_epoch or 1), 1)
         self.fused_config = dict(fused_config or {})
@@ -452,7 +492,11 @@ class RLEpochLoop:
         self.params = self.model.init(jax.random.PRNGKey(self.seed), obs0)
 
         from ddls_tpu.models.policy import batched_policy_apply
-        self.mesh = make_mesh(n_devices)
+        # replicated/fsdp build the exact 1-D dp mesh make_mesh always
+        # built; tp builds the ("dp", "mp") mesh its layout shards over
+        self.mesh = _partition.mesh_for_layout(n_devices,
+                                               self.param_sharding,
+                                               self.tp_size)
         self.apply_fn = lambda p, o: batched_policy_apply(self.model, p, o)
         self._build_learner()
         # warm-start / mid-training resume (the reference has no Launcher
@@ -489,6 +533,7 @@ class RLEpochLoop:
                 "pipeline_depth": self.pipeline_depth,
                 "metrics_sync_interval": self.metrics_sync_interval,
                 "device_collector": self.device_collector,
+                "param_sharding": self.param_sharding,
                 "vec_env_backend": self.vec_env_backend,
                 "n_devices": getattr(self.mesh, "size", None),
                 "seed": self.seed,
@@ -517,7 +562,8 @@ class RLEpochLoop:
     def _make_learner(self):
         from ddls_tpu.rl.ppo import PPOLearner
 
-        return PPOLearner(self.apply_fn, self.ppo_cfg, self.mesh)
+        return PPOLearner(self.apply_fn, self.ppo_cfg, self.mesh,
+                          param_sharding=self.param_sharding)
 
     def _build_learner(self) -> None:
         from ddls_tpu.rl.rollout import RolloutCollector
@@ -702,7 +748,8 @@ class RLEpochLoop:
             # (depth in-flight batches + the consumed one + slack)
             ring_segments=int(self.sebulba_config.get("ring_segments")
                               or self.pipeline_depth + 2),
-            memo_cfg=self._memo_knob())
+            memo_cfg=self._memo_knob(),
+            param_layout=self.param_sharding)
 
     def _memo_knob(self):
         """The ``use_jax_lookahead_memo`` algo key as the value the
@@ -797,11 +844,26 @@ class RLEpochLoop:
 
         env0, et, ot = self._device_tables()
         stacked = self._stacked_banks(et, env0, self.num_envs)
+        mesh = self._collection_mesh(self.num_envs)
+        params_shardings = None
+        if self.param_sharding != "replicated":
+            if mesh is None:
+                raise ValueError(
+                    f"param_sharding={self.param_sharding!r} needs the "
+                    "device collector's lanes sharded over the training "
+                    f"mesh, but num_envs={self.num_envs} does not "
+                    "divide its dp axis — size num_envs to a multiple "
+                    "of the dp width (single-device collection would "
+                    "implicitly gather the sharded params every "
+                    "collect)")
+            from ddls_tpu.parallel.partition import params_shardings_of
+            params_shardings = params_shardings_of(
+                self.learner._state_shardings(self.state))
         return DevicePPOCollector(et, ot, self.model, stacked,
                                   self.rollout_length,
-                                  mesh=self._collection_mesh(
-                                      self.num_envs),
-                                  memo_cfg=self._memo_knob())
+                                  mesh=mesh,
+                                  memo_cfg=self._memo_knob(),
+                                  params_shardings=params_shardings)
 
     # ----------------------------------------------------------------- epoch
     def _split_rng(self):
@@ -1437,6 +1499,7 @@ class ApexDQNEpochLoop(RLEpochLoop):
     # replay insertion + epsilon schedules step the HOST envs; a fused
     # in-kernel epoch cannot express them (rejected loudly in __init__)
     SUPPORTS_FUSED = False
+    SUPPORTS_PARAM_SHARDING = False  # host replay insertion path
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.dqn_cfg = dqn_config_from_rllib(algo_config)
@@ -1687,7 +1750,8 @@ class ImpalaEpochLoop(RLEpochLoop):
     def _make_learner(self):
         from ddls_tpu.rl.impala import ImpalaLearner
 
-        return ImpalaLearner(self.apply_fn, self.impala_cfg, self.mesh)
+        return ImpalaLearner(self.apply_fn, self.impala_cfg, self.mesh,
+                             param_sharding=self.param_sharding)
 
     def _fused_step_fn(self):
         # V-trace update takes no rng; the per-round key split still
@@ -1709,7 +1773,8 @@ class PGEpochLoop(RLEpochLoop):
     def _make_learner(self):
         from ddls_tpu.rl.pg import PGLearner
 
-        return PGLearner(self.apply_fn, self.pg_cfg, self.mesh)
+        return PGLearner(self.apply_fn, self.pg_cfg, self.mesh,
+                         param_sharding=self.param_sharding)
 
     def _fused_step_fn(self):
         step = self.learner._train_step  # REINFORCE update takes no rng
@@ -1730,6 +1795,7 @@ class ESEpochLoop(RLEpochLoop):
     # population fitness steps the HOST envs (the fully on-device ES
     # path is rl/es_device.py); fused epochs are rejected loudly
     SUPPORTS_FUSED = False
+    SUPPORTS_PARAM_SHARDING = False  # host population-fitness path
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.es_cfg = es_config_from_rllib(algo_config)

@@ -77,6 +77,27 @@ def rows_from_payload(artifact: str, round_no: Optional[int],
                 rows.append(_row(artifact, round_no, label,
                                  f"loop_modes.{mode}.env_steps_per_sec",
                                  v, "env_steps/s", platform))
+    # partition-mode payloads (round 13): per-layout update throughput
+    # AND per-device live state bytes, keyed by the model scale so a
+    # canonical and a wide line in one artifact stay distinct rows
+    layouts = payload.get("layouts")
+    if isinstance(layouts, dict):
+        scale = payload.get("model_scale")
+        part_label = label or (f"model_scale={scale}" if scale else None)
+        for layout, st in sorted(layouts.items()):
+            if not isinstance(st, dict):
+                continue
+            if st.get("env_steps_per_sec") is not None:
+                rows.append(_row(
+                    artifact, round_no, part_label,
+                    f"layouts.{layout}.env_steps_per_sec",
+                    st["env_steps_per_sec"], "env_steps/s", platform))
+            if st.get("state_bytes_per_device") is not None:
+                rows.append(_row(
+                    artifact, round_no, part_label,
+                    f"layouts.{layout}.state_bytes_per_device",
+                    st["state_bytes_per_device"], "bytes/device",
+                    platform))
     # A/B payloads (sebulba_ab, impala depth A/B, fused solo) carry
     # per-arm dicts instead of a headline metric
     for key, st in payload.items():

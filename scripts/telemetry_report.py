@@ -38,6 +38,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from collections import OrderedDict, defaultdict
 from typing import Any, Dict, List
@@ -224,8 +225,11 @@ def _transfer_section(transfers: List[dict]) -> List[str]:
     by_name: Dict[str, List[dict]] = defaultdict(list)
     for rec in transfers:
         by_name[rec.get("name", "?")].append(rec)
+    # layout-tagged hop names (sebulba.params[gather-from-fsdp],
+    # rl/sebulba.py) overflow a fixed column — size it to the names
+    w = max(24, max(len(n) for n in by_name) + 2)
     lines = ["== transfers (gated ledger; bytes from aval metadata) ==",
-             f"{'hop':<24}{'dir':<6}{'count':>7}{'total_MB':>10}"
+             f"{'hop':<{w}}{'dir':<6}{'count':>7}{'total_MB':>10}"
              f"{'mean_ms':>10}{'p95_ms':>10}{'MB/s':>10}"]
     for name in sorted(by_name):
         recs = by_name[name]
@@ -234,7 +238,7 @@ def _transfer_section(transfers: List[dict]) -> List[str]:
         total_s = float(durs.sum())
         bw = (total_b / 1e6 / total_s) if total_s > 0 else 0.0
         lines.append(
-            f"{name:<24}{recs[-1].get('direction', '?'):<6}"
+            f"{name:<{w}}{recs[-1].get('direction', '?'):<6}"
             f"{len(recs):>7}{total_b / 1e6:>10.3f}"
             f"{durs.mean() * 1e3:>10.3f}"
             f"{float(np.percentile(durs, 95)) * 1e3:>10.3f}"
@@ -256,19 +260,32 @@ def _sebulba_section(transfers: List[dict],
             if r.get("direction") in ("l2a", "a2l")]
     if not hops:
         return []
-    lines = ["== sebulba cross-mesh hops (explicit device_put only) ==",
-             f"{'hop':<24}{'dir':<6}{'count':>7}{'total_MB':>10}"
-             f"{'mean_ms':>10}"]
     by_name: Dict[str, List[dict]] = defaultdict(list)
     for rec in hops:
         by_name[rec.get("name", "?")].append(rec)
+    w = max(24, max(len(n) for n in by_name) + 2)
+    lines = ["== sebulba cross-mesh hops (explicit device_put only) ==",
+             f"{'hop':<{w}}{'dir':<6}{'count':>7}{'total_MB':>10}"
+             f"{'mean_ms':>10}"]
     for name in sorted(by_name):
         recs = by_name[name]
         durs = np.asarray([float(r.get("dur_s", 0.0)) for r in recs])
         total_b = sum(int(r.get("bytes", 0)) for r in recs)
-        lines.append(f"{name:<24}{recs[-1].get('direction', '?'):<6}"
+        lines.append(f"{name:<{w}}{recs[-1].get('direction', '?'):<6}"
                      f"{len(recs):>7}{total_b / 1e6:>10.3f}"
                      f"{durs.mean() * 1e3:>10.3f}")
+    # the params hop carries its resolved partition layout in the name
+    # (rl/sebulba.py "sebulba.params[gather-from-<layout>]"; plain
+    # "sebulba.params" = replicated) — say it outright so a sharded
+    # learner's gather cost is attributable without decoding the tag
+    layouts = set()
+    for n in by_name:
+        if n.startswith("sebulba.params"):
+            m = re.search(r"\[gather-from-([^\]]+)\]", n)
+            layouts.add(m.group(1) if m else "replicated")
+    if layouts:
+        lines.append(f"{'params_hop_layout':<{w}}"
+                     f"{', '.join(sorted(layouts))}")
     actor_s = sum(span_durations.get("train.collect", []))
     learner_s = sum(span_durations.get("train.update_device", []))
     if actor_s or learner_s:

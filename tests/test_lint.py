@@ -636,6 +636,94 @@ def test_frozen_param_tree_suppressed(tmp_path):
     assert any(f.suppressed for f in res.findings)
 
 
+# partition-rule table cross-validation (same rule id, ISSUE 19): the
+# fixture module is only PARSED — P/PartitionSpec need not resolve.
+def _part_src(fsdp_rules):
+    return (
+        'FSDP_AXIS = "dp"\n'
+        "CANONICAL_PARAM_PATHS = (\n"
+        '    "gnn/Dense_0/kernel",\n'
+        '    "gnn/Dense_0/bias",\n'
+        '    "logit_head/Dense_0/kernel",\n'
+        ")\n"
+        'LARGE_KERNEL_PATHS = ("logit_head/Dense_0/kernel",)\n'
+        "PARTITION_RULES = {\n"
+        '    "replicated": ((r".*", P()),),\n'
+        '    "fsdp": (\n'
+        + fsdp_rules +
+        "    ),\n"
+        "}\n")
+
+
+def test_partition_table_clean(tmp_path):
+    src = _part_src(
+        '        (r"Dense_\\d+/kernel$", P(FSDP_AXIS, None)),\n'
+        '        (r"Dense_\\d+/bias$", P()),\n')
+    res = lint_tree(tmp_path, {"partition.py": src}, "frozen-param-tree")
+    assert res.errors == []
+
+
+def test_partition_table_stale_rule_fires(tmp_path):
+    src = _part_src(
+        '        (r"decoder/Dense_\\d+/kernel$", P(FSDP_AXIS, None)),\n'
+        '        (r"Dense_\\d+/kernel$", P(FSDP_AXIS, None)),\n'
+        '        (r"Dense_\\d+/bias$", P()),\n')
+    res = lint_tree(tmp_path, {"partition.py": src}, "frozen-param-tree")
+    (f,) = errors_of(res, "frozen-param-tree")
+    assert "matches no CANONICAL_PARAM_PATHS entry" in f.message
+    assert "decoder" in f.message
+
+
+def test_partition_table_uncovered_path_fires(tmp_path):
+    # no bias rule: gnn/Dense_0/bias would raise in match_partition_rules
+    src = _part_src(
+        '        (r"Dense_\\d+/kernel$", P(FSDP_AXIS, None)),\n')
+    res = lint_tree(tmp_path, {"partition.py": src}, "frozen-param-tree")
+    (f,) = errors_of(res, "frozen-param-tree")
+    assert "covers no rule for canonical path 'gnn/Dense_0/bias'" \
+        in f.message
+
+
+def test_partition_table_unsharded_large_leaf_fires(tmp_path):
+    # a replicate catch-all shadows the sharding rule for the big kernel
+    src = _part_src(
+        '        (r"kernel$", P()),\n'
+        '        (r"Dense_\\d+/kernel$", P(FSDP_AXIS, None)),\n'
+        '        (r"Dense_\\d+/bias$", P()),\n')
+    res = lint_tree(tmp_path, {"partition.py": src}, "frozen-param-tree")
+    msgs = [f.message for f in errors_of(res, "frozen-param-tree")]
+    assert any("first-matches the replicate rule" in m for m in msgs)
+
+
+def test_partition_table_missing_canonical_paths_fires(tmp_path):
+    src = 'PARTITION_RULES = {"replicated": ((r".*", P()),)}\n'
+    res = lint_tree(tmp_path, {"partition.py": src}, "frozen-param-tree")
+    (f,) = errors_of(res, "frozen-param-tree")
+    assert "cannot be cross-validated" in f.message
+
+
+def test_partition_table_suppressed(tmp_path):
+    src = _part_src(
+        '        (r"decoder/.*", P(FSDP_AXIS, None)),  '
+        "# ddls-lint: allow(frozen-param-tree) -- fixture stale rule\n"
+        '        (r"Dense_\\d+/kernel$", P(FSDP_AXIS, None)),\n'
+        '        (r"Dense_\\d+/bias$", P()),\n')
+    res = lint_tree(tmp_path, {"partition.py": src}, "frozen-param-tree")
+    assert res.errors == []
+    assert any(f.suppressed for f in res.findings)
+
+
+def test_partition_table_real_tree_clean():
+    """The shipped rule table in ddls_tpu/parallel/partition.py passes
+    its own cross-validation (and the canonical-path literal there stays
+    in sync with the runtime tree — tests/test_partition.py pins that
+    side)."""
+    from ddls_tpu.lint import run_lint as _run
+    res = _run(rules=get_rules(["frozen-param-tree"]))
+    assert [f for f in res.errors
+            if "partition" in f.rel.lower()] == []
+
+
 # ------------------------------------------------ backend-surface-parity
 def parity_files(jax_env_extra="", host_strings=("'queue_full'",
                                                  "'mounted'"),
