@@ -899,6 +899,153 @@ def run_impala_depth_bench(args) -> dict:
     }
 
 
+def run_fragments_bench(args) -> dict:
+    """Same-box two-process fragments A/B (ISSUE 20,
+    docs/perf_round14.md): the IMPALA pipelined loop collecting over the
+    socket fragment transport (``collect_transport="socket"`` — one
+    spawned actor-host process running the deferred-fetch shm collector,
+    publishing ring segments as framed messages, rl/fragments.py)
+    versus the in-process shm-ring incumbent, identically configured and
+    timed in paired interleaved rounds with the lead rotating (the
+    collect/impala drift-control protocol; headline = socket arm's
+    median round rate, comparison = MEDIAN of paired per-round ratios).
+
+    On one box the two arms timeshare the same cores, so the ratio is
+    the PROTOCOL OVERHEAD plus whatever real two-process overlap the
+    scheduler finds — the multi-host win case is extrapolated from
+    ``collect_bytes_per_step`` (frame counters: params down + segment
+    up per collect), not from this same-box rate ratio (BASELINE.md
+    "fragments").
+
+    The ``fragments`` block (per-actor-host segments/acks/transit,
+    bytes per step) is fetched ONCE from the socket loop's collector at
+    this reporting boundary — host ints off LearnerFragment's counters,
+    never a device fetch; ring blocks likewise ride ``ring_stats()``."""
+    import jax
+
+    from ddls_tpu.rl.shm import shm_available
+    from ddls_tpu.train import make_epoch_loop
+
+    dataset_dir = _make_dataset()
+    env_kwargs = _impala_bench_env_kwargs(args, dataset_dir)
+    B = args.num_envs
+    T = args.rollout_length
+    depth = max(int(args.fragments_depth), 0)
+    arms = ["inprocess", "socket"]
+    # same forcing rationale as the impala A/B: the comparison is about
+    # the TRANSPORT, so subprocess env workers + shm engage wherever
+    # POSIX shm exists (the actor host runs the identical vec-env
+    # config on its side of the socket)
+    use_parallel = shm_available() or _available_cores() > 1
+
+    def make_loop(transport):
+        kwargs = dict(
+            path_to_env_cls="ddls_tpu.envs.partitioning_env."
+                            "RampJobPartitioningEnvironment",
+            env_config=env_kwargs,
+            model=_IMPALA_BENCH_MODEL,
+            algo_config={"train_batch_size": B * T, "num_workers": B},
+            num_envs=B, rollout_length=T,
+            n_devices=len(jax.devices()),
+            use_parallel_envs=use_parallel,
+            vec_env_backend=args.vec_backend,
+            evaluation_interval=None, seed=0, loop_mode="pipelined",
+            pipeline_depth=depth,
+            metrics_sync_interval=1_000_000)
+        if transport == "socket":
+            kwargs.update(collect_transport="socket",
+                          socket_config={"transport": "unix"})
+        return make_epoch_loop("impala", **kwargs)
+
+    loops = {a: make_loop(a) for a in arms}
+
+    def settle(loop):
+        jax.block_until_ready(loop.state.params)
+        for future, _ in loop._collect_futures:
+            future.result()
+
+    telemetry.enable()
+    warm = max(args.warmup_epochs, depth + 2)  # alias probes + queues
+    with telemetry.span("bench.warmup"):
+        for loop in loops.values():
+            for _ in range(warm):
+                loop.run()
+            settle(loop)
+
+    rounds = args.collect_rounds
+    k_epochs = max(args.timed_epochs, 2)
+    acc = {a: {"steps": 0, "wall": 0.0, "rates": []} for a in arms}
+    bench_start = time.perf_counter()
+    completed_rounds = 0
+    for r in range(rounds):
+        if time.perf_counter() - bench_start > 0.8 * args.budget_seconds:
+            break  # a JSON line must land inside the driver's budget
+        order = arms if r % 2 else list(reversed(arms))
+        for a in order:
+            loop = loops[a]
+            steps = 0
+            with telemetry.span(f"bench.run_{a}") as span:
+                for _ in range(k_epochs):
+                    steps += loop.run()["env_steps_this_iter"]
+                settle(loop)
+            st = acc[a]
+            st["steps"] += steps
+            st["wall"] += span.duration_s
+            st["rates"].append(steps / span.duration_s)
+        completed_rounds += 1
+    if not completed_rounds:
+        raise RuntimeError(
+            f"no timed rounds completed (collect_rounds={rounds}, "
+            f"budget_seconds={args.budget_seconds}) — nothing to report")
+
+    # reporting boundary: one counter fetch per arm, then teardown
+    frag_stats = loops["socket"].collector.stats()
+    transports = {}
+    for a in arms:
+        st = acc[a]
+        rates = np.asarray(st["rates"])
+        transports[a] = {
+            "env_steps_per_sec": round(st["steps"] / st["wall"], 2),
+            "median_round_env_steps_per_sec": round(
+                float(np.median(rates)), 2),
+            "per_round_env_steps_per_sec": [round(float(x), 2)
+                                            for x in rates],
+            "ring": loops[a].ring_stats(),
+        }
+    for loop in loops.values():
+        loop.close()
+
+    paired = [s / i for s, i in zip(acc["socket"]["rates"],
+                                    acc["inprocess"]["rates"])]
+    cbps = frag_stats.get("collect_bytes_per_step")
+    return {
+        "metric": "fragments_env_steps_per_sec",
+        "value": transports["socket"]["median_round_env_steps_per_sec"],
+        "unit": "env_steps/s",
+        "vs_baseline": None,
+        "baseline_source": BASELINE_SOURCE,
+        "platform": jax.devices()[0].platform,
+        "pipeline_depth": depth,
+        "transports": transports,
+        # the ISSUE 20 acceptance statistic: median of paired per-round
+        # socket-vs-inprocess rate ratios (same-box overhead+overlap)
+        "socket_ratio_vs_inprocess": round(float(np.median(paired)), 3),
+        "paired_round_ratios": [round(x, 3) for x in paired],
+        # the wire cost the multi-host extrapolation rides on
+        "collect_bytes_per_step": (round(cbps, 1)
+                                   if cbps is not None else None),
+        "fragments": frag_stats,
+        "topology": args.impala_topology,
+        "num_envs": B,
+        "rollout_length": T,
+        "timed_rounds": completed_rounds,
+        "timed_rounds_requested": rounds,
+        "epochs_per_round": k_epochs,
+        "cores": _available_cores(),
+        "telemetry": telemetry.snapshot(),
+    }
+
+
 def run_partition_bench(args) -> dict:
     """Param-partition layout A/B (ISSUE 19, docs/perf_round13.md): one
     jitted PPO update per named layout of the partition-rule table
@@ -2181,7 +2328,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--mode",
                         choices=("ppo", "sim", "jaxenv", "serve",
-                                 "collect", "impala", "partition"),
+                                 "collect", "impala", "partition",
+                                 "fragments"),
                         default="ppo",
                         help="ppo: full train loop; sim: pure env "
                              "stepping; jaxenv: fully-jitted episodes; "
@@ -2195,7 +2343,12 @@ def main(argv=None) -> int:
                              "partition: interleaved param-layout A/B "
                              "of the PPO update (replicated/fsdp/tp, "
                              "parallel/partition.py — env-steps/s + "
-                             "peak live bytes per device per layout)")
+                             "peak live bytes per device per layout); "
+                             "fragments: same-box two-process A/B of "
+                             "the socket fragment transport vs the "
+                             "in-process shm ring (rl/fragments.py — "
+                             "env-steps/s + collect_bytes_per_step + "
+                             "per-segment transit stats)")
     parser.add_argument("--model-scale", choices=("canonical", "wide"),
                         default="canonical",
                         help="partition mode's GNN config: canonical "
@@ -2217,9 +2370,16 @@ def main(argv=None) -> int:
                         help="impala mode: the depth-K arm of the A/B "
                              "(>= 2; depth 1 runs the pre-ring "
                              "single-slab incumbent for comparison)")
+    parser.add_argument("--fragments-depth", type=int, default=1,
+                        help="fragments mode: pipeline depth of BOTH "
+                             "arms (depth 1 gives each arm one "
+                             "background collect overlapping the "
+                             "update — the schedule where transport "
+                             "latency can actually hide)")
     parser.add_argument("--impala-topology",
                         choices=("light", "canonical"), default="light",
-                        help="impala mode env (same rationale as "
+                        help="impala/fragments mode env (same rationale "
+                             "as "
                              "--collect-topology: light makes the loop "
                              "schedule a measurable fraction of the "
                              "epoch wall)")
@@ -2509,6 +2669,26 @@ def _dispatch_mode(args, process_start: float) -> int:
         except Exception:
             tb = traceback.format_exc().strip().splitlines()
             emit({"metric": "impala_env_steps_per_sec", "value": None,
+                  "unit": "env_steps/s", "vs_baseline": None,
+                  "error": " | ".join(tb[-3:])})
+            return 1
+
+    if args.mode == "fragments":
+        # transport A/B on the CPU backend (the arms differ in HOST
+        # process structure, not device work); jitted updates run, so
+        # pin via jax.config.update (the axon sitecustomize gotcha,
+        # CLAUDE.md) — the spawned actor host pins its own child the
+        # same way (scripts/actor_host.py)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            emit(run_fragments_bench(args))
+            return 0
+        except Exception:
+            tb = traceback.format_exc().strip().splitlines()
+            emit({"metric": "fragments_env_steps_per_sec", "value": None,
                   "unit": "env_steps/s", "vs_baseline": None,
                   "error": " | ".join(tb[-3:])})
             return 1

@@ -17,13 +17,16 @@ from ddls_tpu.lint.rules.hot_path_transfer import HotPathTransferRule
 from ddls_tpu.lint.rules.multihost_gates import MultihostGatesRule
 from ddls_tpu.lint.rules.param_tree import FrozenParamTreeRule
 from ddls_tpu.lint.rules.shm_unlink import ShmUnlinkRule
+from ddls_tpu.lint.rules.socket_lifecycle import SocketLifecycleRule
 from ddls_tpu.lint.rules.telemetry_gated import TelemetryGatedRule
 
-#: the three ported tier-1 guards first, then the six prose-invariant rules
+#: the three ported tier-1 guards first, then the seven prose-invariant
+#: rules (socket-lifecycle rides next to its shm-unlink sibling)
 ALL_RULES: List[Rule] = [
     BareTimersRule(),
     FlightGatedRule(),
     ShmUnlinkRule(),
+    SocketLifecycleRule(),
     HotPathTransferRule(),
     MultihostGatesRule(),
     TelemetryGatedRule(),

@@ -1,0 +1,77 @@
+"""socket-lifecycle: every listening/accepted/connected socket must keep
+its paired close + crash-path finalizer.
+
+The shm-unlink contract, extended to the fragment transport
+(ddls_tpu/rl/fragments.py, docs/perf_round14.md): a learner that binds a
+Unix-domain listener owns a filesystem path, N actor-host subprocesses,
+and the fds between them — an interrupted run that never reaches
+``close()`` would leak all three. Contract: a file that creates sockets
+(``socket.socket(``, ``create_connection(``, or ``.accept(``) must also
+carry a ``.close(`` call AND a ``weakref.finalize``/``atexit`` fallback.
+Pure ``import socket`` uses (e.g. ``socket.gethostname()`` in
+telemetry/runlog.py) create nothing and are not flagged. Deliberate
+externally-owned sockets go in
+``[tool.ddls_lint.socket-lifecycle.allow]`` with a why-comment.
+"""
+from __future__ import annotations
+
+import re
+from typing import List
+
+from ddls_tpu.lint.core import Context, Finding, Rule, SourceFile
+
+_CREATE_RE = re.compile(
+    r"socket\s*\.\s*socket\s*\(|create_connection\s*\(|\.accept\s*\(")
+
+
+class SocketLifecycleRule(Rule):
+    id = "socket-lifecycle"
+    pointer = ("pair every socket.socket()/create_connection()/.accept() "
+               "with a .close() on shutdown AND a weakref.finalize/atexit "
+               "fallback (see ddls_tpu/rl/fragments.py LearnerFragment), "
+               "or the fd/unix-socket path outlives a crashed run; "
+               "deliberately externally-owned sockets go in "
+               "[tool.ddls_lint.socket-lifecycle.allow] in pyproject.toml "
+               "with a why-comment")
+    scope_dirs = None  # the whole package
+
+    def check_file(self, sf: SourceFile, ctx: Context) -> List[Finding]:
+        matches = list(_CREATE_RE.finditer(sf.text))
+        if not matches:
+            return []
+        missing = []
+        if ".close(" not in sf.text:
+            missing.append("close")
+        if ("weakref.finalize" not in sf.text
+                and "atexit" not in sf.text):
+            missing.append("finalizer (weakref.finalize/atexit)")
+        if not missing:
+            return []
+        allow = ctx.config.rule(self.id).get("allow", {})
+        allowed = self.int_allowance(allow, sf.rel)
+        # same attribution contract as shm-unlink: suppressed creates
+        # are excluded (and reported as their own suppressed findings);
+        # when the rest exceed the allowance, every unsuppressed create
+        # line is flagged — the allowance has no line identity
+        lines = [sf.text.count("\n", 0, m.start()) + 1 for m in matches]
+        suppressed = self.inline_suppressed_lines(sf)
+        sup = [ln for ln in lines if ln in suppressed]
+        unsup = [ln for ln in lines if ln not in suppressed]
+        findings = [Finding(
+            self.id, sf.rel, ln, "socket create "
+            "(inline-suppressed)") for ln in sup]
+        if len(unsup) > allowed:
+            findings += [Finding(
+                self.id, sf.rel, ln,
+                f"socket create without leak-proof pairing "
+                f"({len(unsup)} create(s) in file, allowance {allowed}), "
+                f"missing {' + '.join(missing)}") for ln in unsup]
+        return findings
+
+    def check_tree(self, ctx: Context) -> List[Finding]:
+        allow = ctx.config.rule(self.id).get("allow", {})
+        return (self.validate_allow_keys(ctx, allow, want_int=True)
+                + self.validate_count_allowances(
+                    ctx, allow,
+                    lambda sf: len(_CREATE_RE.findall(sf.text)),
+                    "socket create"))

@@ -51,6 +51,9 @@ _DIRECTION_DEST = {
     "l2a": "actor mesh",
     "a2l": "learner mesh",
     "d2d": "device",
+    # fragment frames between the learner and its actor-host processes
+    # (rl/fragments.py): host memory on both ends, the wire in between
+    "h2h": "remote host",
 }
 
 

@@ -249,6 +249,11 @@ class RLEpochLoop:
     # device-collection trajectory contract; DQN/ES opt out (their
     # host replay / population paths never consume the spec table)
     SUPPORTS_PARAM_SHARDING = True
+    # socket collection (rl/fragments.py) ships whole [T, B] trajectory
+    # segments from actor-host processes over the shared traj contract;
+    # DQN's replay insertion and ES's population fitness step the host
+    # envs directly and opt out
+    SUPPORTS_SOCKET_COLLECTION = True
 
     def __init__(self,
                  path_to_env_cls: str,
@@ -277,12 +282,43 @@ class RLEpochLoop:
                  param_sharding: str = "replicated",
                  tp_size: Optional[int] = None,
                  path_to_model_cls: Optional[str] = None,  # config parity
+                 collect_transport: str = "inprocess",
+                 socket_config: Optional[dict] = None,
+                 scenario=None,
                  run_ledger=None,
                  **kwargs):
         import jax
 
         from ddls_tpu.rl.rollout import ParallelVectorEnv, VectorEnv
 
+        # scenario plumbing (ddls_tpu/scenarios, ROADMAP item 5): one
+        # ScenarioSpec (name, path, or instance) supplies the env
+        # construction kwargs and (for failure specs) the runtime; an
+        # explicit env_config entry overrides the spec's TOP-LEVEL key
+        # wholesale (never a deep merge — a merged jobs_config would
+        # silently union synthesis knobs). The canonical spec resolves
+        # runtime=None, so its env path is byte-identical to passing the
+        # same env_config by hand.
+        self.scenario_fingerprint: Optional[str] = None
+        if scenario is not None:
+            from ddls_tpu.hardware.topologies import build_topology
+            from ddls_tpu.scenarios.spec import (build_runtime,
+                                                 env_kwargs as
+                                                 _scenario_env_kwargs,
+                                                 get_spec,
+                                                 spec_fingerprint)
+
+            spec = get_spec(scenario) if isinstance(scenario, str) \
+                else scenario
+            merged = dict(_scenario_env_kwargs(spec))
+            merged.update(env_config or {})
+            runtime = build_runtime(spec, build_topology(spec.topology))
+            if runtime is not None:
+                merged["scenario_runtime"] = runtime
+            env_config = merged
+            self.scenario_fingerprint = spec_fingerprint(spec)
+
+        self._env_cls_path = path_to_env_cls
         self.env_cls = get_class_from_path(path_to_env_cls)
         self.env_config = dict(env_config)
         self.metric = metric
@@ -447,6 +483,50 @@ class RLEpochLoop:
                 "device_collector=true or loop_mode='fused' — remove it "
                 "or leave it 'auto' for host collection")
 
+        # socket collection knob (rl/fragments.py, ROADMAP item 4):
+        # trajectory ring segments arrive framed from actor-host
+        # processes; validated BEFORE env construction, the loud-
+        # rejection convention
+        if collect_transport not in ("inprocess", "socket"):
+            raise ValueError(
+                f"collect_transport must be 'inprocess' or 'socket', "
+                f"got {collect_transport!r}")
+        if socket_config and collect_transport != "socket":
+            raise ValueError(
+                "socket_config is a collect_transport='socket' knob: a "
+                "forced config on the in-process path would silently "
+                "no-op — remove it or set collect_transport='socket'")
+        self.collect_transport = collect_transport
+        self.socket_config = dict(socket_config or {})
+        if collect_transport == "socket":
+            if not self.SUPPORTS_SOCKET_COLLECTION:
+                raise ValueError(
+                    f"{type(self).__name__} does not support "
+                    "collect_transport='socket': fragments ship whole "
+                    "[T, B] trajectory segments over the shared traj "
+                    "contract — DQN's replay insertion and ES's "
+                    "population fitness step the host envs directly "
+                    "(use ppo/impala/pg)")
+            if self.loop_mode != "pipelined":
+                raise ValueError(
+                    "collect_transport='socket' requires loop_mode="
+                    "'pipelined': the fragment consumer is the deferred-"
+                    "fetch collector contract (fused/sebulba collect "
+                    "in-kernel; sequential would serialise the only "
+                    "overlap the second process buys)")
+            if self.device_collector:
+                raise ValueError(
+                    "collect_transport='socket' is host collection on "
+                    "the actor hosts — it cannot combine with "
+                    "algo_config.device_collector (the in-kernel env "
+                    "has no vec env to ship)")
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "collect_transport='socket' is single-LEARNER-"
+                    "process: actor hosts are its own spawned "
+                    "subprocesses (multi-host jax runtimes coordinate "
+                    "collectives, not fragment sockets)")
+
         # Multi-host: each process must collect DIFFERENT rollouts (its
         # shard of the global batch), so env seeds and the action-sampling
         # rng are offset by the process index; parameter init and the rng
@@ -456,9 +536,15 @@ class RLEpochLoop:
 
         seed_everything(self.seed)
         host_pool_size = self.num_envs
-        if self.device_collector:
-            # collection runs in-kernel; the host side only needs ONE
-            # in-process env as the obs/param/episode-tables template
+        # the actor hosts inherit the caller's env-parallelism intent
+        # even though the learner itself only keeps a template env
+        self._actor_use_parallel_envs = (
+            use_parallel_envs if use_parallel_envs != "auto"
+            else available_cores() > 1)
+        if self.device_collector or self.collect_transport == "socket":
+            # collection runs in-kernel (device_collector) or on the
+            # actor hosts (socket fragments); the learner side only
+            # needs ONE in-process env as the obs/param template
             # (evaluation builds its own envs via make_eval_env)
             use_parallel_envs = False
             host_pool_size = 1
@@ -486,6 +572,9 @@ class RLEpochLoop:
             n_actions = int(np.asarray(
                 self.vec_env.obs[0]["action_mask"]).shape[0])
         self.n_actions = n_actions
+        # raw model config rides the fragment CONFIG frame so actor
+        # hosts build the identical policy (frozen param-tree paths)
+        self._model_config = model
         self.model = self._build_model(n_actions, model)
 
         obs0 = jax.tree_util.tree_map(np.asarray, self.vec_env.obs[0])
@@ -535,9 +624,17 @@ class RLEpochLoop:
                 "device_collector": self.device_collector,
                 "param_sharding": self.param_sharding,
                 "vec_env_backend": self.vec_env_backend,
+                "collect_transport": self.collect_transport,
                 "n_devices": getattr(self.mesh, "size", None),
                 "seed": self.seed,
             })
+            if (self.scenario_fingerprint is not None
+                    and self.run_ledger.scenario_fingerprint is None):
+                # scenario-built runs are fingerprint-reproducible: the
+                # manifest carries the spec hash unless the caller
+                # already pinned one
+                self.run_ledger.scenario_fingerprint = \
+                    self.scenario_fingerprint
             self.run_ledger.open()
 
     # ------------------------------------------------------------ algo hooks
@@ -584,6 +681,9 @@ class RLEpochLoop:
         if getattr(self, "device_collector", False):
             self.collector = self._make_device_collector()
             return
+        if self.collect_transport == "socket":
+            self.collector = self._make_fragment_collector()
+            return
         self.collector = RolloutCollector(
             self.vec_env, self.learner, self.rollout_length,
             deferred_fetch=(self.loop_mode == "pipelined"),
@@ -593,6 +693,34 @@ class RLEpochLoop:
             ring_segments=(self.pipeline_depth + 2
                            if self.loop_mode == "pipelined" else None))
         self.collector._needs_reset = False  # env already reset in __init__
+
+    def _make_fragment_collector(self):
+        """Socket fragment consumer (rl/fragments.py): actor-host
+        subprocesses run the deferred-fetch collector against THEIR
+        envs and ship trajectory ring segments as framed messages; the
+        returned LearnerFragment duck-types the collector contract —
+        its segments live in the learner's OWN TrajRing, so run()'s
+        canonical two-phase release (note_staged/note_update) applies
+        unchanged."""
+        from ddls_tpu.rl.fragments import LearnerFragment
+
+        cfg = dict(self.socket_config)
+        return LearnerFragment(
+            env_cls_path=self._env_cls_path,
+            env_config=self.env_config,
+            model_config=self._model_config,
+            n_actions=self.n_actions,
+            num_envs=self.num_envs,
+            rollout_length=self.rollout_length,
+            collect_seed=self._collect_seed,
+            global_seed=self.seed,
+            # same sizing as the in-process pipelined ring: depth
+            # prefetched batches + the one consumed + one of slack
+            ring_segments=self.pipeline_depth + 2,
+            num_actor_hosts=int(cfg.pop("num_actor_hosts", 1)),
+            use_parallel_envs=self._actor_use_parallel_envs,
+            vec_env_backend=self.vec_env_backend,
+            **cfg)
 
     def _fused_step_fn(self):
         """The learner's UNJITTED update for in-scan tracing inside the
@@ -1193,6 +1321,15 @@ class RLEpochLoop:
             ring = out.get("ring")
             if ring is not None:
                 ring.observe_params_age(age)
+        transit = out.get("segment_transit_s")
+        if transit is not None:
+            # params_age_updates' sibling (rl/fragments.py): wire +
+            # framing lag per segment, net of the actor's own collect
+            # wall — says what the network costs, in seconds, next to
+            # what staleness costs, in updates. Already a host float
+            # (single-clock durations), never a device fetch.
+            extras = dict(extras or {})
+            extras["segment_transit_s"] = transit
         learner_metrics = self._harvest_metrics(metrics, extras=extras)
         self._maybe_sync_metrics()
         episodes = out["episodes"]
@@ -1500,6 +1637,7 @@ class ApexDQNEpochLoop(RLEpochLoop):
     # in-kernel epoch cannot express them (rejected loudly in __init__)
     SUPPORTS_FUSED = False
     SUPPORTS_PARAM_SHARDING = False  # host replay insertion path
+    SUPPORTS_SOCKET_COLLECTION = False  # replay needs per-step control
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.dqn_cfg = dqn_config_from_rllib(algo_config)
@@ -1796,6 +1934,7 @@ class ESEpochLoop(RLEpochLoop):
     # path is rl/es_device.py); fused epochs are rejected loudly
     SUPPORTS_FUSED = False
     SUPPORTS_PARAM_SHARDING = False  # host population-fitness path
+    SUPPORTS_SOCKET_COLLECTION = False  # fitness steps envs directly
 
     def _configure_algo(self, algo_config, num_envs, rollout_length) -> None:
         self.es_cfg = es_config_from_rllib(algo_config)

@@ -98,6 +98,25 @@ def rows_from_payload(artifact: str, round_no: Optional[int],
                     f"layouts.{layout}.state_bytes_per_device",
                     st["state_bytes_per_device"], "bytes/device",
                     platform))
+    # fragments-mode payloads (round 14): per-transport loop rates plus
+    # the protocol's wire cost — the number the multi-host extrapolation
+    # rides on, so it gets its own gated history row
+    transports = payload.get("transports")
+    if isinstance(transports, dict):
+        for tname, st in sorted(transports.items()):
+            if isinstance(st, dict) and \
+                    st.get("env_steps_per_sec") is not None:
+                rows.append(_row(
+                    artifact, round_no, label,
+                    f"fragments.{tname}.env_steps_per_sec",
+                    st["env_steps_per_sec"], "env_steps/s", platform))
+        if isinstance(payload.get("collect_bytes_per_step"),
+                      (int, float)):
+            rows.append(_row(
+                artifact, round_no, label,
+                "fragments.collect_bytes_per_step",
+                payload["collect_bytes_per_step"], "bytes/step",
+                platform))
     # A/B payloads (sebulba_ab, impala depth A/B, fused solo) carry
     # per-arm dicts instead of a headline metric
     for key, st in payload.items():

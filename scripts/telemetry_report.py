@@ -300,6 +300,54 @@ def _sebulba_section(transfers: List[dict],
     return lines + [""]
 
 
+def _fragments_section(transfers: List[dict],
+                       sections: Dict[str, Dict[str, Any]]) -> List[str]:
+    """Cross-host fragment accounting (rl/fragments.py,
+    collect_transport='socket'): only renders when the run carried
+    ``h2h`` frames (params broadcasts out, trajectory segments in).
+    Reports each frame kind's count/bytes/mean duration plus a
+    per-actor-host table — segments published, acks returned, mean/max
+    segment transit (wire + framing lag net of the actor's own collect
+    wall), and the learner ring's stall count (an acked-but-stalled
+    ring means the UPDATE gated collection, not the wire)."""
+    hops = [r for r in transfers if r.get("direction") == "h2h"]
+    if not hops:
+        return []
+    by_name: Dict[str, List[dict]] = defaultdict(list)
+    for rec in hops:
+        by_name[rec.get("name", "?")].append(rec)
+    w = max(24, max(len(n) for n in by_name) + 2)
+    lines = ["== cross-host fragments (h2h frames) ==",
+             f"{'frame':<{w}}{'count':>7}{'total_MB':>10}{'mean_ms':>10}"]
+    for name in sorted(by_name):
+        recs = by_name[name]
+        durs = np.asarray([float(r.get("dur_s", 0.0)) for r in recs])
+        total_b = sum(int(r.get("bytes", 0)) for r in recs)
+        lines.append(f"{name:<{w}}{len(recs):>7}"
+                     f"{total_b / 1e6:>10.3f}{durs.mean() * 1e3:>10.3f}")
+    counters = sections.get("counters") or {}
+    hists = sections.get("histograms") or {}
+    hosts = sorted({k.split(".")[1] for k in counters
+                    if k.startswith("fragments.h")})
+    if hosts:
+        lines += ["", f"{'actor host':<12}{'segments':>10}{'acks':>8}"
+                      f"{'transit_mean_ms':>17}{'transit_max_ms':>16}"]
+        for h in hosts:
+            segs = counters.get(f"fragments.{h}.segments", 0)
+            acks = counters.get(f"fragments.{h}.acks", 0)
+            transit = hists.get(f"fragments.{h}.transit_s") or {}
+            mean = transit.get("mean")
+            mx = transit.get("max")
+            lines.append(
+                f"{h:<12}{segs:>10}{acks:>8}"
+                f"{(mean * 1e3 if mean is not None else 0.0):>17.3f}"
+                f"{(mx * 1e3 if mx is not None else 0.0):>16.3f}")
+    stalls = counters.get("rollout.ring.stall")
+    if stalls is not None:
+        lines.append(f"{'learner_ring_stalls':<28}{stalls:>10}")
+    return lines + [""]
+
+
 def _ring_section(sections: Dict[str, Dict[str, Any]]) -> List[str]:
     """Trajectory-ring ledger rollup (rl/ring.py, ISSUE 15): lease/
     stall/publish/release counters, the lease-time occupancy histogram
@@ -434,9 +482,12 @@ def render_report(path: str) -> List[str]:
         lines += [""]
     if span_intervals:
         lines += _overlap_section(span_intervals)
+    snapshot_sections = (_walk_snapshot(last_snapshot)
+                         if last_snapshot else {})
     if transfers:
         lines += _transfer_section(transfers)
         lines += _sebulba_section(transfers, span_durations)
+        lines += _fragments_section(transfers, snapshot_sections)
     if flight_events:
         lines += _flight_section(flight_events)
     if event_counts:
@@ -451,7 +502,7 @@ def render_report(path: str) -> List[str]:
     if isinstance(last_snapshot.get("serve"), dict):
         lines += _fleet_section(last_snapshot["serve"])
     if last_snapshot:
-        sections = _walk_snapshot(last_snapshot)
+        sections = snapshot_sections
         lines += _ring_section(sections)
         if sections.get("counters"):
             lines += ["== counters (last snapshot) =="]

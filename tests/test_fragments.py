@@ -1,0 +1,359 @@
+"""Cross-host dataflow fragments pins (ISSUE 20, rl/fragments.py,
+docs/perf_round14.md).
+
+* frame codec — scatter-gather SEGMENT encode → socket → sink-directed
+  recv round-trips bit-exactly; the incremental FrameAssembler survives
+  torn prefixes/headers/bodies; desynchronised streams and mismatched
+  sinks fail loudly;
+* loud rejections — collect_transport='socket' refuses DQN/ES,
+  non-pipelined loop modes, the device collector, and an orphaned
+  socket_config BEFORE any env construction;
+* the acceptance pin — a single-actor-host depth-0 PPO run over the
+  socket transport is BIT-exact vs the in-process path (learner
+  metrics, episode records content AND order, env_steps, post-training
+  params), its steady-state epoch stays transfer-guard-clean with the
+  fragment consumer engaged, and killing the actor host surfaces as a
+  loud RuntimeError naming the host — with zero /dev/shm or socket-path
+  litter after close();
+* depth-K staleness — the IMPALA depth-1 socket loop reports
+  ``params_age_updates`` exactly as the in-process ring does, with the
+  ``segment_transit_s`` sibling riding the same metrics mapping.
+
+Tests needing real POSIX shared memory carry the ``shm`` marker (the
+actor host's vec env and the learner ring both slab over /dev/shm).
+"""
+import os
+import socket
+
+import numpy as np
+import pytest
+
+from ddls_tpu.rl.fragments import (AckToken, FrameAssembler, PREFIX_BYTES,
+                                   T_ACK, T_CONFIG, T_SEGMENT, encode_frame,
+                                   frame_nbytes, parse_address, recv_frame,
+                                   send_frame)
+
+
+# ---------------------------------------------------------------- codec
+def _segment_fields(rng):
+    return {
+        "obs:node_features": rng.rand(4, 3, 5).astype(np.float32),
+        "actions": rng.randint(0, 7, (4, 3)).astype(np.int32),
+        "rewards": rng.rand(4, 3).astype(np.float64),
+    }
+
+
+def _segment_frame(fields, seq=3):
+    header = {"seq": seq,
+              "fields": [(k, v.shape, v.dtype.str)
+                         for k, v in fields.items()],
+              "collect_wall_s": 0.125}
+    return header, encode_frame(T_SEGMENT, header,
+                                [memoryview(v).cast("B")
+                                 for v in fields.values()])
+
+
+def test_segment_roundtrip_with_sink():
+    """encode → socketpair → recv_frame: every field lands bit-exact;
+    a sink-provided destination (the learner ring-segment view) is
+    written IN PLACE — the recv is the lease-time write."""
+    rng = np.random.RandomState(0)
+    fields = _segment_fields(rng)
+    header, parts = _segment_frame(fields)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"".join(bytes(p) for p in parts))
+        sink_buf = np.empty((4, 3, 5), np.float32)
+
+        def sink(name, shape, dtype):
+            return sink_buf if name == "obs:node_features" else None
+
+        ftype, got_header, got = recv_frame(b, field_sink=sink)
+    finally:
+        a.close()
+        b.close()
+    assert ftype == T_SEGMENT
+    assert got_header["seq"] == header["seq"]
+    assert got["obs:node_features"] is sink_buf  # in-place recv
+    for k, v in fields.items():
+        np.testing.assert_array_equal(got[k], v, err_msg=k)
+        assert got[k].dtype == v.dtype, k
+
+
+def test_send_frame_counts_every_byte():
+    a, b = socket.socketpair()
+    try:
+        n = send_frame(a, T_ACK, {"seq": 9})
+        assert n == frame_nbytes(encode_frame(T_ACK, {"seq": 9}))
+        ftype, header, fields = recv_frame(b)
+        assert (ftype, header, fields) == (T_ACK, {"seq": 9}, {})
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_assembler_torn_frames():
+    """Two frames fed in 7-byte chunks: each emerges only once complete
+    (torn prefix/header/body all wait), then the buffer drains to 0."""
+    fields = _segment_fields(np.random.RandomState(2))
+    header, parts = _segment_frame(fields)
+    wire = (b"".join(bytes(p)
+                     for p in encode_frame(T_CONFIG, {"num_envs": 2}))
+            + b"".join(bytes(p) for p in parts))
+    asm = FrameAssembler()
+    out = []
+    for i in range(0, len(wire), 7):
+        out.extend(asm.feed(wire[i:i + 7]))
+    assert asm.pending_bytes == 0
+    assert [(f[0], f[1].get("num_envs"), f[1].get("seq"))
+            for f in out] == [(T_CONFIG, 2, None), (T_SEGMENT, None, 3)]
+    # the SEGMENT body is the concatenated raw field bytes in table order
+    assert out[1][2] == b"".join(v.tobytes() for v in fields.values())
+
+
+def test_frame_assembler_bad_magic_is_loud():
+    asm = FrameAssembler()
+    with pytest.raises(ValueError, match="magic"):
+        asm.feed(b"XXXX" + b"\0" * PREFIX_BYTES)
+
+
+def test_recv_frame_sink_mismatch_is_loud():
+    fields = _segment_fields(np.random.RandomState(3))
+    _, parts = _segment_frame(fields)
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"".join(bytes(p) for p in parts))
+        with pytest.raises(ValueError, match="sink shape/dtype"):
+            recv_frame(b, field_sink=lambda *_:
+                       np.empty((1, 1), np.float32))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_body_without_field_table_is_loud():
+    parts = encode_frame(T_SEGMENT, {"no": "fields"},
+                         [memoryview(b"junkjunk")])
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"".join(bytes(p) for p in parts))
+        with pytest.raises(ValueError, match="no field table"):
+            recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parse_address_forms():
+    assert parse_address("unix:/tmp/x.sock") == (socket.AF_UNIX,
+                                                 "/tmp/x.sock")
+    assert parse_address("tcp:127.0.0.1:5001") == (socket.AF_INET,
+                                                   ("127.0.0.1", 5001))
+    with pytest.raises(ValueError, match="unix:"):
+        parse_address("udp:nope")
+
+
+def test_ack_token_protocol():
+    tok = AckToken()
+    assert not tok.is_ready()
+    tok.set()
+    assert tok.is_ready()
+
+
+# ----------------------------------------------------- loud rejections
+_TINY_MODEL = {"fcnet_hiddens": [16],
+               "custom_model_config": {"out_features_msg": 4,
+                                       "out_features_hidden": 8,
+                                       "out_features_node": 4,
+                                       "out_features_graph": 4}}
+
+ENV_CLS = "ddls_tpu.envs.partitioning_env.RampJobPartitioningEnvironment"
+
+
+def _env_config(dataset_dir):
+    return dict(
+        topology_config={"type": "ramp", "kwargs": {
+            "num_communication_groups": 2,
+            "num_racks_per_communication_group": 2,
+            "num_servers_per_rack": 2,
+            "num_channels": 1,
+            "total_node_bandwidth": 1.6e12}},
+        node_config={"type_1": {"num_nodes": 8, "workers_config": [
+            {"num_workers": 1, "worker": "A100"}]}},
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 100.0},
+            "replication_factor": 4,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 2},
+        max_partitions_per_op=4,
+        reward_function="job_acceptance",
+        max_simulation_run_time=5e4,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+
+
+def _loop_kwargs(dataset_dir, **over):
+    kw = dict(path_to_env_cls=ENV_CLS,
+              env_config=_env_config(dataset_dir),
+              model=_TINY_MODEL,
+              algo_config={"train_batch_size": 8,
+                           "sgd_minibatch_size": 4,
+                           "num_sgd_iter": 2, "num_workers": 2},
+              num_envs=2, rollout_length=4, n_devices=2,
+              use_parallel_envs=True, evaluation_interval=None, seed=0,
+              loop_mode="pipelined",
+              collect_transport="socket",
+              socket_config={"transport": "unix"})
+    kw.update(over)
+    return kw
+
+
+@pytest.mark.parametrize("algo,over,match", [
+    ("apex_dqn", {"algo_config": {}}, "does not support"),
+    ("es", {"algo_config": {}}, "does not support"),
+    ("ppo", {"loop_mode": "sequential"}, "requires loop_mode"),
+    ("ppo", {"algo_config": {"train_batch_size": 8,
+                             "device_collector": True}},
+     "device_collector"),
+    ("ppo", {"collect_transport": "inprocess"}, "socket_config"),
+    ("ppo", {"collect_transport": "carrier-pigeon",
+             "socket_config": None}, "collect_transport"),
+], ids=["dqn", "es", "sequential", "device-collector",
+        "orphan-config", "bad-transport"])
+def test_socket_transport_loud_rejections(algo, over, match, dataset_dir):
+    """Every unsupported combination is rejected BEFORE env construction
+    with a message that says why (the ES/DQN opt-out convention)."""
+    from ddls_tpu.train import make_epoch_loop
+
+    with pytest.raises(ValueError, match=match):
+        make_epoch_loop(algo, **_loop_kwargs(dataset_dir, **over))
+
+
+# -------------------------------------------- parity / guard / teardown
+def _leaked(names):
+    return [n for n in names
+            if os.path.exists(os.path.join("/dev/shm", n.lstrip("/")))]
+
+
+def _epoch_record(r, socket_arm):
+    learner = dict(r["learner"])
+    if socket_arm:
+        # the transport's own metrics ride the mapping; everything else
+        # must match the in-process arm bit-for-bit
+        assert learner.pop("segment_transit_s") >= 0.0
+    return {"learner": learner, "episodes": r["episodes"],
+            "env_steps": r["env_steps_this_iter"]}
+
+
+@pytest.mark.shm
+def test_socket_parity_transfer_guard_and_teardown(dataset_dir):
+    """The ISSUE 20 acceptance pin, three phases on ONE socket loop:
+
+    1. parity — 3 epochs of single-actor-host depth-0 PPO over the
+       socket transport reproduce the in-process arm bit-for-bit
+       (metrics, episodes content AND order, env_steps, final params);
+       the 3rd socket epoch additionally runs under
+       ``jax.transfer_guard("disallow")`` — the steady-state fragment
+       epoch performs NO implicit device↔host transfer (params leave
+       via the collector's explicit device_get, segments enter via the
+       collector's explicit device_put staging);
+    2. teardown — SIGTERM on the actor host makes the NEXT collect
+       raise a RuntimeError naming the host and its pid (no hang, no
+       silent truncation);
+    3. litter — after close(), the unix socket path, its tempdir, and
+       every learner-ring /dev/shm segment are gone."""
+    import jax
+
+    from ddls_tpu.train import make_epoch_loop
+
+    outcomes = {}
+    for transport in ("inprocess", "socket"):
+        over = ({} if transport == "socket"
+                else {"collect_transport": "inprocess",
+                      "socket_config": None})
+        loop = make_epoch_loop("ppo", **_loop_kwargs(dataset_dir, **over))
+        records = []
+        for epoch in range(3):
+            if transport == "socket" and epoch == 2:
+                with jax.transfer_guard("disallow"):
+                    r = loop.run()
+            else:
+                r = loop.run()
+            records.append(_epoch_record(r, transport == "socket"))
+        loop.sync_metrics()
+        params = jax.device_get(loop.state.params)
+        if transport == "socket":
+            frag = loop.collector
+            address = frag.address
+            assert address.startswith("unix:")
+            sock_path = address[len("unix:"):]
+            assert os.path.exists(sock_path)
+            shm_names = [n for seg in frag.ring.segments
+                         for n in seg.slabs.segment_names()]
+            assert shm_names  # the learner ring really slabbed
+            stats = frag.stats()
+            # the pipelined loop prefetches, so >= epochs consumed —
+            # but every received segment must have been acked
+            assert stats["segments"] == stats["per_host"]["h0"]["acks"] >= 3
+            assert stats["collect_bytes_per_step"] > 0
+
+            # phase 2: kill the actor host — loud, named, no hang
+            (proc,) = frag._procs
+            proc.terminate()
+            proc.wait(timeout=30)
+            with pytest.raises(RuntimeError,
+                               match=r"actor host 0 \(pid \d+"):
+                for _ in range(3):  # a prefetched segment may absorb one
+                    loop.run()
+            loop.close()
+            loop.close()  # idempotent
+            # phase 3: zero litter on every surface the learner owns
+            assert not os.path.exists(sock_path)
+            assert not os.path.exists(os.path.dirname(sock_path))
+            assert _leaked(shm_names) == []
+        else:
+            loop.close()
+        outcomes[transport] = (records, params)
+
+    ref_records, ref_params = outcomes["inprocess"]
+    soc_records, soc_params = outcomes["socket"]
+    for e, (rr, rs) in enumerate(zip(ref_records, soc_records)):
+        assert rr["env_steps"] == rs["env_steps"], f"epoch {e}"
+        assert rr["learner"] == rs["learner"], f"epoch {e} metrics"
+        assert rr["episodes"] == rs["episodes"], f"epoch {e} episodes"
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)),
+        ref_params, soc_params)
+
+
+@pytest.mark.shm
+def test_socket_depth1_staleness_counters(dataset_dir):
+    """IMPALA depth-K staleness rides the socket transport unchanged:
+    the steady-state batch is exactly one update stale
+    (``params_age_updates`` — V-trace's lag), with the wire's own cost
+    reported beside it (``segment_transit_s``), and the learner ring
+    sized depth + 2 like the in-process ledger."""
+    from ddls_tpu.train import make_epoch_loop
+
+    loop = make_epoch_loop("impala", **_loop_kwargs(
+        dataset_dir,
+        algo_config={"lr": 1e-3, "train_batch_size": 8,
+                     "num_workers": 2},
+        pipeline_depth=1))
+    try:
+        assert len(loop.collector.ring.segments) == 3  # depth + 2
+        metrics = [dict(loop.run()["learner"]) for _ in range(3)]
+        loop.sync_metrics()
+        assert metrics[0]["params_age_updates"] == 0.0  # warm inline batch
+        assert metrics[-1]["params_age_updates"] == 1.0  # steady state
+        for m in metrics:
+            assert m["segment_transit_s"] >= 0.0
+        stats = loop.collector.stats()
+        assert stats["num_actor_hosts"] == 1
+        assert stats["segments"] >= 3
+        assert stats["per_host"]["h0"]["transit_max_s"] >= 0.0
+    finally:
+        loop.close()

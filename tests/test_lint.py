@@ -45,9 +45,9 @@ def errors_of(result, rule):
 
 
 # ------------------------------------------------------------ registry
-def test_registry_has_all_nine_rules():
+def test_registry_has_all_ten_rules():
     assert RULE_IDS == [
-        "bare-timers", "flight-gated", "shm-unlink",
+        "bare-timers", "flight-gated", "shm-unlink", "socket-lifecycle",
         "hot-path-transfer", "multihost-deterministic-gates",
         "telemetry-gated", "flow-mask", "frozen-param-tree",
         "backend-surface-parity"]
@@ -276,6 +276,65 @@ def test_shm_unlink_suppressed(tmp_path):
     res = lint_tree(tmp_path, {"scratch.py": src}, "shm-unlink")
     assert res.errors == []
     assert any(f.suppressed for f in res.findings)
+
+
+# ------------------------------------------------------ socket-lifecycle
+SOCK_BAD = ("import socket\n"
+            "lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)\n"
+            "conn, _ = lst.accept()\n")
+SOCK_GOOD = ("import socket\n"
+             "import weakref\n"
+             "lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)\n"
+             "conn, _ = lst.accept()\n"
+             "weakref.finalize(lst, lst.close)\n"
+             "conn.close()\n")
+
+
+def test_socket_lifecycle_fires(tmp_path):
+    # one finding per create site (socket() AND accept()), each on its
+    # line, naming what is missing
+    res = lint_tree(tmp_path, {"leaky.py": SOCK_BAD}, "socket-lifecycle")
+    found = errors_of(res, "socket-lifecycle")
+    assert [(f.rel, f.line) for f in found] == [("leaky.py", 2),
+                                               ("leaky.py", 3)]
+    assert all("close" in f.message and "finalizer" in f.message
+               for f in found)
+
+
+def test_socket_lifecycle_clean(tmp_path):
+    res = lint_tree(tmp_path, {"ok.py": SOCK_GOOD}, "socket-lifecycle")
+    assert res.errors == []
+
+
+def test_socket_lifecycle_import_only_not_flagged(tmp_path):
+    # `import socket` for gethostname() creates nothing (runlog.py)
+    src = "import socket\nhost = socket.gethostname()\n"
+    res = lint_tree(tmp_path, {"host.py": src}, "socket-lifecycle")
+    assert res.findings == []
+
+
+def test_socket_lifecycle_inline_suppression_covers_only_its_create(
+        tmp_path):
+    src = ("import socket\n"
+           "a = socket.socket()  "
+           "# ddls-lint: allow(socket-lifecycle) -- caller-owned fd\n"
+           "b = socket.socket()\n")
+    res = lint_tree(tmp_path, {"leaky.py": src}, "socket-lifecycle")
+    (f,) = errors_of(res, "socket-lifecycle")
+    assert f.line == 3
+    assert any(x.suppressed and x.line == 2 for x in res.findings)
+
+
+def test_socket_lifecycle_overgranted_allowance_is_stale(tmp_path):
+    src = "import socket\ns = socket.socket()\n"
+    res = lint_tree(tmp_path, {"leaky.py": src}, "socket-lifecycle",
+                    {"socket-lifecycle": {"allow": {"leaky.py": 2}}})
+    (f,) = errors_of(res, "socket-lifecycle")
+    assert f.rel == "pyproject.toml"
+    assert "stale" in f.message and "grants 2" in f.message
+    res = lint_tree(tmp_path, {"leaky.py": src}, "socket-lifecycle",
+                    {"socket-lifecycle": {"allow": {"leaky.py": 1}}})
+    assert res.errors == []
 
 
 # ---------------------------------------------------- hot-path-transfer

@@ -251,3 +251,84 @@ def test_conformance_cli_full_legs():
     for rep in doc["specs"]:
         for leg in rep["legs"]:
             assert leg["status"] in ("ok", "skipped", "unavailable"), leg
+
+
+# ----------------------------------------- training-loop scenario plumbing
+_LOOP_ENV_CLS = ("ddls_tpu.envs.partitioning_env."
+                 "RampJobPartitioningEnvironment")
+_LOOP_TINY_MODEL = {"fcnet_hiddens": [16],
+                    "custom_model_config": {"out_features_msg": 4,
+                                            "out_features_hidden": 8,
+                                            "out_features_node": 4,
+                                            "out_features_graph": 4}}
+
+
+def _loop_overrides(dataset_dir):
+    """Tiny-workload env_config overrides: each key REPLACES the spec's
+    top-level key wholesale (the loops.py merge contract — never a deep
+    merge)."""
+    return dict(
+        jobs_config={
+            "path_to_files": dataset_dir,
+            "job_interarrival_time_dist": {
+                "_target_": "ddls_tpu.demands.distributions.Fixed",
+                "val": 100.0},
+            "replication_factor": 4,
+            "job_sampling_mode": "remove_and_repeat",
+            "num_training_steps": 2},
+        max_partitions_per_op=4,
+        max_simulation_run_time=5e4,
+        pad_obs_kwargs={"max_nodes": 32, "max_edges": 64})
+
+
+def _scenario_loop(scenario, dataset_dir):
+    from ddls_tpu.train import make_epoch_loop
+
+    return make_epoch_loop(
+        "ppo",
+        path_to_env_cls=_LOOP_ENV_CLS,
+        env_config=_loop_overrides(dataset_dir),
+        model=_LOOP_TINY_MODEL,
+        algo_config={"train_batch_size": 4, "sgd_minibatch_size": 2,
+                     "num_sgd_iter": 1, "num_workers": 2},
+        num_envs=2, rollout_length=2, n_devices=1,
+        use_parallel_envs=False, evaluation_interval=None, seed=0,
+        loop_mode="pipelined", scenario=scenario)
+
+
+def test_epoch_loop_canonical_scenario_is_byte_identical(dataset_dir):
+    """ISSUE 20 satellite: make_epoch_loop(scenario=...) resolves the
+    spec into env construction kwargs with explicit env_config keys
+    replacing spec keys wholesale, and records the fingerprint. The
+    canonical spec builds runtime=None, so the resulting env_config is
+    EXACTLY the hand-built dict — no scenario_runtime key, byte-
+    identical env path."""
+    from ddls_tpu.scenarios import env_kwargs
+
+    spec = canonical_spec()
+    loop = _scenario_loop("canonical", dataset_dir)
+    try:
+        expected = dict(env_kwargs(spec))
+        expected.update(_loop_overrides(dataset_dir))
+        assert loop.env_config == expected
+        assert "scenario_runtime" not in loop.env_config
+        assert loop.scenario_fingerprint == spec_fingerprint(spec)
+    finally:
+        loop.close()
+
+
+def test_epoch_loop_failure_scenario_carries_runtime(dataset_dir):
+    """A failure spec's resolved ScenarioRuntime rides env_config into
+    every constructed env (cluster.scenario_runtime), keyed by the spec
+    fingerprint; a spec instance is accepted as well as a name."""
+    spec = failures_spec()
+    loop = _scenario_loop(spec, dataset_dir)
+    try:
+        rt = loop.env_config["scenario_runtime"]
+        assert rt is not None
+        assert rt.fingerprint == spec_fingerprint(spec)
+        env = loop.vec_env.envs[0]
+        assert env.cluster.scenario_runtime is rt
+        assert loop.scenario_fingerprint == spec_fingerprint(spec)
+    finally:
+        loop.close()
